@@ -1,0 +1,31 @@
+"""spark_examples_tpu — a TPU-native framework for population-scale genomics.
+
+A ground-up JAX/XLA/pjit re-design with the capabilities of the reference
+``googlegenomics/spark-examples`` stack: streaming variant/read ingest over
+sharded genomic ranges, the search/pileup/coverage example drivers, and the
+``VariantsPcaDriver`` principal-coordinate (PCoA) pipeline — genotype blocks
+streamed into sharded ``jax.Array``s, ``jnp.einsum`` + ``jnp.linalg.eigh``
+under ``pjit`` over ICI/DCN instead of Spark shuffle + Breeze/MLlib on a
+driver JVM.
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+
+- :mod:`spark_examples_tpu.genomics` — host data plane: typed records, shard
+  manifests, sources, callset index (replaces the reference's L1/L2 client +
+  custom RDD layer).
+- :mod:`spark_examples_tpu.arrays`  — ingest→device: dense genotype blocks,
+  double-buffered feeds.
+- :mod:`spark_examples_tpu.ops`     — device math under ``jit``: Gramian,
+  double-centering, PCoA/eig, reads kernels.
+- :mod:`spark_examples_tpu.parallel`— mesh + collectives: pjit shardings,
+  blockwise variant-axis streaming, multi-host init.
+- :mod:`spark_examples_tpu.models`  — the pipelines ("apps"): PCA driver and
+  the search-variants / search-reads examples (replaces the reference L3).
+- :mod:`spark_examples_tpu.utils`   — config/flags, IO stats, checkpointing,
+  logging.
+- :mod:`spark_examples_tpu.cli`     — command-line entry points.
+- :mod:`spark_examples_tpu.bridge`  — the PcaBackend seam so external drivers
+  can delegate the dense math.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,35 @@
+"""Device math under ``jit``: the dense kernels of the framework.
+
+The reference computes its N×N sample co-occurrence ("similarity") matrix by
+an O(k²) scalar double loop per variant into a per-task Breeze DenseMatrix
+(reference ``VariantsPca.scala:184-189``) followed by a Spark ``reduceByKey``
+shuffle of all N² entries, and eigendecomposes on the driver JVM via
+Breeze/LAPACK (``VariantsPca.scala:225-226``). Here the same math is a batched
+matmul on the MXU: ``G = X @ X.T`` over dense 0/1 genotype-indicator blocks,
+blockwise-accumulated over the variant axis, then double-centering and
+``eigh`` — all fused under ``jit``.
+"""
+
+from spark_examples_tpu.ops.gramian import (
+    gramian,
+    gramian_accumulate,
+    gramian_blockwise,
+)
+from spark_examples_tpu.ops.centering import double_center
+from spark_examples_tpu.ops.pcoa import (
+    pcoa,
+    principal_components,
+    mllib_principal_components_reference,
+    normalize_eigvec_signs,
+)
+
+__all__ = [
+    "gramian",
+    "gramian_accumulate",
+    "gramian_blockwise",
+    "double_center",
+    "pcoa",
+    "principal_components",
+    "mllib_principal_components_reference",
+    "normalize_eigvec_signs",
+]

@@ -1,0 +1,34 @@
+"""Double-centering (classical MDS / PCoA).
+
+Reference semantics (``VariantsPca.scala:193-223``): row sums are collected
+to the driver, broadcast back, and each entry is centered as
+
+    c_ij = g_ij − rowMean_i − colMean_j + matrixMean
+
+with ``matrixMean = ΣG / N²``. Here it is three reductions and one fused
+elementwise expression under ``jit`` — no collect/broadcast round-trip; under
+``pjit`` the row/column means become XLA collectives over the mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["double_center"]
+
+
+@jax.jit
+def double_center(g):
+    """Center a (possibly non-symmetric) similarity matrix G.
+
+    Returns C with ``C[i, j] = G[i, j] - rowmean[i] - colmean[j] + grandmean``.
+    For symmetric G the result is symmetric with exactly-zero row/column means
+    (up to float rounding) — the property the PCoA eigendecomposition relies
+    on (see :mod:`spark_examples_tpu.ops.pcoa`).
+    """
+    g = g.astype(jnp.promote_types(g.dtype, jnp.float32))
+    rowmean = jnp.mean(g, axis=1, keepdims=True)
+    colmean = jnp.mean(g, axis=0, keepdims=True)
+    grandmean = jnp.mean(g)
+    return g - rowmean - colmean + grandmean

@@ -1,0 +1,8 @@
+"""Smoke test that the virtual 8-device CPU mesh is actually wired up."""
+
+import jax
+
+
+def test_virtual_device_count():
+    assert jax.default_backend() == "cpu"
+    assert jax.device_count() == 8
