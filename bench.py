@@ -1,0 +1,123 @@
+"""North-star benchmark: PCoA distance+eig phase on TPU vs CPU reference.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``
+
+Workload (BASELINE.md): 1000-Genomes-scale cohort — N=2504 samples,
+V=65,536 variants, ~10% carrier density — streamed through the blockwise
+Gramian + double-centering + 2-PC eigendecomposition.
+
+``value`` is the driver-defined metric samples²·variants/sec for the TPU
+path (steady-state: compile excluded, host→device transfer included — the
+block stream is part of the phase).
+
+``vs_baseline`` is the measured speedup over the reference semantics on
+CPU: the numpy per-partition dense accumulation exactly as the reference's
+PySpark twin does it (``variants_pca.py:54-82``: ``matrix[ix, ix] += 1``
+per variant) plus driver-style float64 LAPACK eigendecomposition
+(``VariantsPca.scala:225-226``). The reference publishes no numbers
+(SURVEY.md §6), so the baseline is measured here, on this machine, on the
+same workload. The accumulation part is measured on a V/16 slice and scaled
+linearly (it is embarrassingly linear in V); eig is measured in full.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# Defaults are the 1000-Genomes-scale config; env overrides exist so the
+# bench logic itself can be exercised on CPU (where a 2504×65536 matmul
+# would take minutes) — the driver runs with defaults on the real chip.
+N_SAMPLES = int(os.environ.get("BENCH_SAMPLES", 2504))
+BLOCK_V = int(os.environ.get("BENCH_BLOCK_V", 8192))
+N_BLOCKS = int(os.environ.get("BENCH_BLOCKS", 8))
+N_VARIANTS = BLOCK_V * N_BLOCKS
+DENSITY = 0.1
+NUM_PC = 2
+
+
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_blocks(seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.random((N_SAMPLES, BLOCK_V)) < DENSITY).astype(np.int8)
+        for _ in range(N_BLOCKS)
+    ]
+
+
+def tpu_time(blocks):
+    import jax
+
+    # Persistent compilation cache: the N≈2500 eigh compile is minutes the
+    # first time; cached thereafter.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    from spark_examples_tpu.ops import gramian_blockwise, pcoa
+
+    # Warm-up: compile both programs on a throwaway pass.
+    _log(f"bench: compiling (N={N_SAMPLES}, V={N_VARIANTS}) ...")
+    g = gramian_blockwise(blocks[:1], N_SAMPLES)
+    pcoa(g, NUM_PC)[0].block_until_ready()
+    _log("bench: compiled; timing steady-state")
+
+    t0 = time.perf_counter()
+    g = gramian_blockwise(blocks, N_SAMPLES)
+    coords, _ = pcoa(g, NUM_PC)
+    coords.block_until_ready()
+    return time.perf_counter() - t0, np.asarray(coords)
+
+
+def cpu_reference_time(blocks):
+    """Reference semantics on CPU: per-variant numpy accumulation
+    (variants_pca.py:67-75) + f64 centering/eig (VariantsPca.scala:198-226)."""
+    sample_idx = []
+    for b in blocks[:1]:
+        cols = b.shape[1] // 16
+        for c in range(cols):
+            sample_idx.append(np.nonzero(b[:, c])[0])
+
+    g = np.zeros((N_SAMPLES, N_SAMPLES), dtype=np.int64)
+    t0 = time.perf_counter()
+    for idx in sample_idx:
+        g[np.ix_(idx, idx)] += 1
+    t_accum_slice = time.perf_counter() - t0
+    t_accum = t_accum_slice * (N_VARIANTS / len(sample_idx))
+
+    from spark_examples_tpu.ops import mllib_principal_components_reference
+
+    t0 = time.perf_counter()
+    coords, _ = mllib_principal_components_reference(
+        g.astype(np.float64), NUM_PC
+    )
+    t_eig = time.perf_counter() - t0
+    return t_accum + t_eig, coords
+
+
+def main():
+    blocks = make_blocks()
+    t_tpu, coords_tpu = tpu_time(blocks)
+    t_cpu, _ = cpu_reference_time(blocks)
+
+    value = N_SAMPLES * N_SAMPLES * N_VARIANTS / t_tpu
+    print(
+        json.dumps(
+            {
+                "metric": "pcoa_samples2_variants_per_sec",
+                "value": value,
+                "unit": "samples^2*variants/s",
+                "vs_baseline": t_cpu / t_tpu,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

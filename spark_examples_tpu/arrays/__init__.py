@@ -1,0 +1,9 @@
+"""Ingest → device: dense genotype blocks and double-buffered feeds."""
+
+from spark_examples_tpu.arrays.blocks import (
+    blocks_from_calls,
+    densify_calls,
+    DEFAULT_BLOCK_VARIANTS,
+)
+
+__all__ = ["blocks_from_calls", "densify_calls", "DEFAULT_BLOCK_VARIANTS"]

@@ -1,0 +1,54 @@
+"""Densify per-variant sample-index lists into fixed-shape genotype blocks.
+
+The bridge between the ragged host world (per-variant lists of carrying
+sample indices, the ``RDD[Seq[Int]]`` interface at VariantsPca.scala:153-168)
+and the static-shape device world: 0/1 indicator blocks
+``X_blk ∈ {0,1}^(N × B)`` with a *fixed* block width B, so every
+``G += X_blk @ X_blk.T`` step hits the same compiled executable.
+
+Padding is free correctness-wise: a padded (all-zero) variant column
+contributes nothing to the Gramian, so the final partial block is zero-padded
+rather than specialising a second program shape.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Sequence
+
+import numpy as np
+
+__all__ = ["densify_calls", "blocks_from_calls", "DEFAULT_BLOCK_VARIANTS"]
+
+# 2^13 variant columns per block: at N=2504 samples an int8 block is ~20 MB
+# host-side — large enough to keep the MXU busy, small enough to double
+# buffer in HBM comfortably. Multiple of 128 (lane width).
+DEFAULT_BLOCK_VARIANTS = 8192
+
+
+def densify_calls(
+    calls: Sequence[Sequence[int]], n_samples: int, width: int = None
+) -> np.ndarray:
+    """Per-variant index lists → one (n_samples, width) 0/1 int8 block."""
+    width = width if width is not None else len(calls)
+    x = np.zeros((n_samples, width), dtype=np.int8)
+    for col, sample_indices in enumerate(calls):
+        idx = np.asarray(sample_indices, dtype=np.int64)
+        if idx.size:
+            x[idx, col] = 1
+    return x
+
+
+def blocks_from_calls(
+    calls_iter: Iterable[Sequence[int]],
+    n_samples: int,
+    block_variants: int = DEFAULT_BLOCK_VARIANTS,
+) -> Iterator[np.ndarray]:
+    """Stream ragged call lists into fixed-shape zero-padded blocks."""
+    buf: List[Sequence[int]] = []
+    for calls in calls_iter:
+        buf.append(calls)
+        if len(buf) == block_variants:
+            yield densify_calls(buf, n_samples, block_variants)
+            buf = []
+    if buf:
+        yield densify_calls(buf, n_samples, block_variants)

@@ -1,0 +1,25 @@
+"""The PcaBackend seam: delegate the dense math from any external driver.
+
+The reference already factors its pipeline so the dense math is replaceable
+— the PySpark twin drives the Scala ingest through py4j and hands row RDDs
+back to the JVM for the eigendecomposition (``variants_pca.py:123-152``).
+This package is that seam as a service: an external driver (the Scala
+``VariantsPcaDriver``, or anything else) streams per-variant sample-index
+lists — exactly the ``RDD[Seq[Int]]`` interface at
+``VariantsPca.scala:153-168`` — and receives principal coordinates computed
+on TPU.
+"""
+
+from spark_examples_tpu.bridge.backend import (
+    PcaBackend,
+    TpuPcaBackend,
+    PcaBridgeServer,
+    PcaBridgeClient,
+)
+
+__all__ = [
+    "PcaBackend",
+    "TpuPcaBackend",
+    "PcaBridgeServer",
+    "PcaBridgeClient",
+]

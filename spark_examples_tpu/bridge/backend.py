@@ -1,0 +1,167 @@
+"""PcaBackend implementations + a newline-JSON TCP bridge.
+
+Protocol (one JSON object per line, UTF-8):
+
+    → {"cmd": "init", "n_samples": N, "num_pc": k}
+    → {"cmd": "calls", "batch": [[s0, s1, ...], ...]}   (repeatable)
+    → {"cmd": "finish"}
+    ← {"coords": [[pc1, pc2, ...], ...], "eigvals": [...]}
+
+Newline-JSON over a socket keeps the bridge dependency-free on both sides
+(a JVM client needs ~20 lines; no protobuf/py4j/grpc pinning) while the
+payload — integer index lists — is exactly the reference's
+``RDD[Seq[Int]]`` stage boundary, so a Spark driver can ship partitions
+straight through ``collect``-free ``foreachPartition`` writes.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import Iterable, List, Optional, Protocol, Sequence
+
+import numpy as np
+
+__all__ = ["PcaBackend", "TpuPcaBackend", "PcaBridgeServer", "PcaBridgeClient"]
+
+
+class PcaBackend(Protocol):
+    """The seam: per-variant sample-index lists in, coordinates out."""
+
+    def compute(
+        self, calls: Iterable[Sequence[int]], n_samples: int, num_pc: int
+    ): ...
+
+
+class TpuPcaBackend:
+    """In-process backend: blockwise Gramian + PCoA on the local device(s).
+
+    The ``JaxTpuPcaBackend`` of the BASELINE north star; the counterpart
+    ``SparkBreezePcaBackend`` is the reference's own driver-side math.
+    """
+
+    def __init__(self, mesh=None, block_variants: int = 8192):
+        self.mesh = mesh
+        self.block_variants = block_variants
+
+    def compute(
+        self, calls: Iterable[Sequence[int]], n_samples: int, num_pc: int
+    ):
+        from spark_examples_tpu.arrays.blocks import blocks_from_calls
+        from spark_examples_tpu.ops import gramian_blockwise, pcoa
+
+        blocks = blocks_from_calls(calls, n_samples, self.block_variants)
+        if self.mesh is not None:
+            from spark_examples_tpu.parallel.sharded import (
+                sharded_gramian_blockwise,
+                sharded_pcoa,
+            )
+
+            g = sharded_gramian_blockwise(blocks, n_samples, self.mesh)
+            coords, eigvals = sharded_pcoa(g, num_pc, self.mesh)
+        else:
+            g = gramian_blockwise(blocks, n_samples)
+            coords, eigvals = pcoa(g, num_pc)
+        return np.asarray(coords), np.asarray(eigvals)
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        backend: PcaBackend = self.server.backend  # type: ignore[attr-defined]
+        n_samples = num_pc = None
+        batches: List[List[int]] = []
+        for raw in self.rfile:
+            msg = json.loads(raw)
+            cmd = msg.get("cmd")
+            if cmd == "init":
+                n_samples = int(msg["n_samples"])
+                num_pc = int(msg["num_pc"])
+            elif cmd == "calls":
+                batches.extend(msg["batch"])
+            elif cmd == "finish":
+                if n_samples is None:
+                    self._reply({"error": "finish before init"})
+                    return
+                coords, eigvals = backend.compute(
+                    iter(batches), n_samples, num_pc
+                )
+                self._reply(
+                    {
+                        "coords": np.asarray(coords).tolist(),
+                        "eigvals": np.asarray(eigvals).tolist(),
+                    }
+                )
+                return
+            else:
+                self._reply({"error": f"unknown cmd {cmd!r}"})
+                return
+
+    def _reply(self, obj) -> None:
+        self.wfile.write((json.dumps(obj) + "\n").encode())
+
+
+class PcaBridgeServer:
+    """Threaded TCP server wrapping any PcaBackend."""
+
+    def __init__(self, backend: Optional[PcaBackend] = None, port: int = 0):
+        self._srv = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", port), _Handler
+        )
+        self._srv.daemon_threads = True
+        self._srv.backend = backend or TpuPcaBackend()  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    def start(self) -> "PcaBridgeServer":
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class PcaBridgeClient:
+    """Reference client (the role the Scala driver's PcaBackend stub plays)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.create_connection((host, port))
+        self._file = self._sock.makefile("rwb")
+
+    def _send(self, obj) -> None:
+        self._file.write((json.dumps(obj) + "\n").encode())
+        self._file.flush()
+
+    def compute(
+        self,
+        calls: Iterable[Sequence[int]],
+        n_samples: int,
+        num_pc: int,
+        batch_size: int = 4096,
+    ):
+        self._send({"cmd": "init", "n_samples": n_samples, "num_pc": num_pc})
+        batch: List[List[int]] = []
+        for c in calls:
+            batch.append([int(i) for i in c])
+            if len(batch) >= batch_size:
+                self._send({"cmd": "calls", "batch": batch})
+                batch = []
+        if batch:
+            self._send({"cmd": "calls", "batch": batch})
+        self._send({"cmd": "finish"})
+        resp = json.loads(self._file.readline())
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return np.asarray(resp["coords"]), np.asarray(resp["eigvals"])
+
+    def close(self) -> None:
+        self._file.close()
+        self._sock.close()

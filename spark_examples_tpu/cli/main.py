@@ -1,0 +1,114 @@
+"""CLI: ``python -m spark_examples_tpu.cli.main <command> [flags]``.
+
+One subcommand per reference entry point (``README.md:51-61`` of the
+reference lists the runnable mains), with the GenomicsConf/PcaConf flag
+surface, plus fixture tooling so every pipeline runs hermetically now that
+the Genomics v1 API is retired.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from spark_examples_tpu.genomics.fixtures import (
+    DEFAULT_VARIANT_SET_ID,
+    synthetic_cohort,
+)
+from spark_examples_tpu.genomics.sources import JsonlSource
+from spark_examples_tpu.utils.config import (
+    add_pca_flags,
+    pca_config_from_args,
+)
+
+__all__ = ["main"]
+
+
+def _add_fixture_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--fixture-samples",
+        type=int,
+        default=None,
+        help="Run against an in-memory synthetic cohort of this many samples",
+    )
+    p.add_argument("--fixture-variants", type=int, default=1000)
+    p.add_argument("--fixture-seed", type=int, default=0)
+
+
+def _resolve_source(args, references: str):
+    if args.input_path:
+        return JsonlSource(args.input_path)
+    if args.fixture_samples:
+        return synthetic_cohort(
+            args.fixture_samples,
+            args.fixture_variants,
+            references=references,
+            seed=args.fixture_seed,
+            variant_set_id=(args.variant_set_ids or [DEFAULT_VARIANT_SET_ID])[0],
+        )
+    raise SystemExit(
+        "No data source: pass --input-path <jsonl cohort dir> or "
+        "--fixture-samples N (the Genomics v1 API is retired; network "
+        "sources implement the VariantSource protocol)"
+    )
+
+
+def _cmd_pca(args) -> int:
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+
+    conf = pca_config_from_args(args)
+    if not args.variant_set_ids:
+        conf.variant_set_ids = [DEFAULT_VARIANT_SET_ID]
+    refs = conf.references
+    source = _resolve_source(args, refs)
+    mesh = None
+    if conf.mesh_shape:
+        from spark_examples_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(conf.mesh_shape)
+    driver = VariantsPcaDriver(conf, source, mesh=mesh)
+    driver.run()
+    return 0
+
+
+def _cmd_generate_fixture(args) -> int:
+    """Write a JSONL cohort directory for offline runs."""
+    src = synthetic_cohort(
+        args.fixture_samples or 100,
+        args.fixture_variants,
+        references=args.references,
+        seed=args.fixture_seed,
+        variant_set_id=(args.variant_set_ids or [DEFAULT_VARIANT_SET_ID])[0],
+    )
+    src.dump(args.out)
+    print(f"Wrote cohort to {args.out}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="spark_examples_tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    pca = sub.add_parser("pca", help="VariantsPcaDriver: PCoA over a cohort")
+    add_pca_flags(pca)
+    _add_fixture_flags(pca)
+    pca.set_defaults(fn=_cmd_pca)
+
+    gen = sub.add_parser(
+        "generate-fixture", help="Write a synthetic JSONL cohort"
+    )
+    add_pca_flags(gen)
+    _add_fixture_flags(gen)
+    gen.add_argument("--out", required=True)
+    gen.set_defaults(fn=_cmd_generate_fixture)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""Host data plane: typed genomic records, shard manifests, sources.
+
+Replaces the reference's L1 client + L2 custom-RDD layers
+(``Client.scala``, ``rdd/VariantsRDD.scala``, ``rdd/ReadsRDD.scala``) with a
+framework-neutral host-side data plane: plain dataclasses, deterministic
+shard manifests (the partitioners), pluggable streaming sources (fixture /
+file / service), and the callset index that fixes the similarity-matrix
+dimension N before any variant is read.
+"""
+
+from spark_examples_tpu.genomics.types import (
+    Call,
+    Read,
+    Variant,
+    VariantKey,
+    ReadKey,
+    normalize_contig,
+    has_variation,
+    CIGAR_MATCH,
+)
+from spark_examples_tpu.genomics.hashing import murmur3_x64_128, variant_identity
+from spark_examples_tpu.genomics.shards import (
+    Shard,
+    SexChromosomeFilter,
+    HUMAN_CHROMOSOMES,
+    shards_for_references,
+    shards_for_all_references,
+    parse_references,
+)
+from spark_examples_tpu.genomics.callsets import CallsetIndex
+from spark_examples_tpu.genomics.sources import (
+    VariantSource,
+    ReadSource,
+    FixtureSource,
+    JsonlSource,
+)
+
+__all__ = [
+    "Call",
+    "Read",
+    "Variant",
+    "VariantKey",
+    "ReadKey",
+    "normalize_contig",
+    "has_variation",
+    "CIGAR_MATCH",
+    "murmur3_x64_128",
+    "variant_identity",
+    "Shard",
+    "SexChromosomeFilter",
+    "HUMAN_CHROMOSOMES",
+    "shards_for_references",
+    "shards_for_all_references",
+    "parse_references",
+    "CallsetIndex",
+    "VariantSource",
+    "ReadSource",
+    "FixtureSource",
+    "JsonlSource",
+]

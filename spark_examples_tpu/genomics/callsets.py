@@ -1,0 +1,54 @@
+"""Callset index: fixes the similarity-matrix dimension N up front.
+
+``VariantsCommon.scala:38-50``: before any variant is read, the driver pages
+through the callsets of every configured variantset, assigns each callset a
+dense index 0..N−1 (in listing order across sets), and records
+callsetId → sampleName. N is the Gramian dimension — static, which is
+exactly what XLA wants: every downstream array shape is known at trace time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Sequence
+
+from spark_examples_tpu.genomics.sources import VariantSource
+
+__all__ = ["CallsetIndex"]
+
+
+@dataclass(frozen=True)
+class CallsetIndex:
+    indexes: Dict[str, int]  # callsetId → dense sample index
+    names: Dict[str, str]  # callsetId → sample name
+
+    @property
+    def size(self) -> int:
+        return len(self.indexes)
+
+    @staticmethod
+    def from_source(
+        source: VariantSource, variant_set_ids: Sequence[str]
+    ) -> "CallsetIndex":
+        indexes: Dict[str, int] = {}
+        names: Dict[str, str] = {}
+        for vsid in variant_set_ids:
+            for cs in source.list_callsets(vsid):
+                if cs.id not in indexes:
+                    indexes[cs.id] = len(indexes)
+                    names[cs.id] = cs.name
+        print(f"Matrix size: {len(indexes)}")  # VariantsCommon.scala:48
+        return CallsetIndex(indexes=indexes, names=names)
+
+    def name_of_index(self) -> List[str]:
+        """Dense index → sample name (for result emission)."""
+        out = [""] * len(self.indexes)
+        for cid, idx in self.indexes.items():
+            out[idx] = self.names[cid]
+        return out
+
+    def callset_of_index(self) -> List[str]:
+        out = [""] * len(self.indexes)
+        for cid, idx in self.indexes.items():
+            out[idx] = cid
+        return out

@@ -1,0 +1,124 @@
+"""Dataset assembly: AF filtering, multi-dataset join/merge, call extraction.
+
+The host-side transformations between raw variant streams and the dense
+genotype blocks the device consumes — the semantics of
+``VariantsPca.scala:96-168`` without the Spark shuffle machinery: identity
+join/merge run in plain dictionaries keyed by the murmur3 variant identity,
+then per-variant carrying-sample index lists flow straight into the block
+densifier (:mod:`spark_examples_tpu.arrays.blocks`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+from spark_examples_tpu.genomics.hashing import variant_identity
+from spark_examples_tpu.genomics.types import Variant, has_variation
+
+__all__ = [
+    "af_filter",
+    "carrying_sample_indices",
+    "join_datasets",
+    "merge_datasets",
+    "calls_stream",
+]
+
+
+def af_filter(
+    variants: Iterable[Variant], min_allele_frequency: Optional[float]
+) -> Iterator[Variant]:
+    """Keep variants with ``info["AF"][0] >= threshold``.
+
+    Missing AF drops the variant (``.getOrElse(false)``,
+    VariantsPca.scala:100-104). ``None`` threshold disables the filter.
+    """
+    if min_allele_frequency is None:
+        yield from variants
+        return
+    for v in variants:
+        af = v.info.get("AF")
+        if af and float(af[0]) >= min_allele_frequency:
+            yield v
+
+
+def carrying_sample_indices(
+    variant: Variant, indexes: Dict[str, int]
+) -> List[int]:
+    """Dense sample indices whose call carries a non-reference allele.
+
+    extractCallInfo + the variation filter of getCallsRdd
+    (VariantsPca.scala:56-60, 157-160). Callsets absent from the index are a
+    hard error, as in the reference (``mapping(call.callsetId)`` throws).
+    """
+    out = []
+    for call in variant.calls or ():
+        if has_variation(call):
+            out.append(indexes[call.callset_id])
+    return out
+
+
+def identity(variant: Variant) -> str:
+    return variant_identity(
+        variant.contig,
+        variant.start,
+        variant.end,
+        variant.reference_bases,
+        variant.alternate_bases,
+    )
+
+
+def join_datasets(
+    a: Iterable[Variant], b: Iterable[Variant], indexes: Dict[str, int]
+) -> Iterator[List[int]]:
+    """Two-dataset inner join on variant identity (VariantsPca.scala:115-128).
+
+    Yields concatenated carrying-sample index lists for variants present in
+    both datasets.
+    """
+    left: Dict[str, List[int]] = {}
+    for v in a:
+        left[identity(v)] = carrying_sample_indices(v, indexes)
+    for v in b:
+        key = identity(v)
+        if key in left:
+            yield left[key] + carrying_sample_indices(v, indexes)
+
+
+def merge_datasets(
+    streams: Sequence[Iterable[Variant]], indexes: Dict[str, int]
+) -> Iterator[List[int]]:
+    """N-way merge keeping variants present in *all* datasets.
+
+    The reference unions all sets, groups by identity, and keeps groups of
+    size == dataset count (VariantsPca.scala:136-148) — record count, not
+    distinct-set count, replicated here.
+    """
+    groups: Dict[str, List[int]] = {}
+    counts: Dict[str, int] = {}
+    for stream in streams:
+        for v in stream:
+            key = identity(v)
+            counts[key] = counts.get(key, 0) + 1
+            groups.setdefault(key, []).extend(
+                carrying_sample_indices(v, indexes)
+            )
+    want = len(streams)
+    for key, calls in groups.items():
+        if counts[key] == want:
+            yield calls
+
+
+def calls_stream(
+    streams: Sequence[Iterable[Variant]], indexes: Dict[str, int]
+) -> Iterator[List[int]]:
+    """Dispatch 1/2/N datasets → per-variant index lists, dropping variants
+    with no carrying samples (getCallsRdd, VariantsPca.scala:153-168)."""
+    if len(streams) == 1:
+        gen = (carrying_sample_indices(v, indexes) for v in streams[0])
+    elif len(streams) == 2:
+        gen = join_datasets(streams[0], streams[1], indexes)
+    else:
+        gen = merge_datasets(streams, indexes)
+    for calls in gen:
+        if calls:
+            yield calls

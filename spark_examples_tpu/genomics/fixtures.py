@@ -1,0 +1,114 @@
+"""Synthetic cohort generation: hermetic, deterministic fixtures.
+
+The Genomics v1 API is retired, so tests and benchmarks run against
+generated cohorts with the same shape as the reference's inputs: a callset
+per sample (1000-Genomes-style names), variants across a genomic region with
+per-sample genotype calls, AF info fields, and a sprinkling of non-numeric
+contigs that must be dropped by the builder (the ``VariantsRDD.scala:132-135``
+semantics the hermetic fixture is meant to exercise — SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from spark_examples_tpu.genomics.sources import Callset, FixtureSource
+from spark_examples_tpu.genomics.shards import BRCA1_REFERENCES, parse_references
+
+__all__ = ["synthetic_cohort", "DEFAULT_VARIANT_SET_ID"]
+
+DEFAULT_VARIANT_SET_ID = "fixture-platinum"
+
+_BASES = ("A", "C", "G", "T")
+
+
+def _sample_name(i: int) -> str:
+    return f"NA{20000 + i:05d}" if i % 2 == 0 else f"HG{i:05d}"
+
+
+def synthetic_cohort(
+    n_samples: int,
+    n_variants: int,
+    references: str = BRCA1_REFERENCES,
+    variant_set_id: str = DEFAULT_VARIANT_SET_ID,
+    seed: int = 0,
+    population_structure: int = 2,
+    dropped_contig_every: Optional[int] = None,
+    stats=None,
+) -> FixtureSource:
+    """Build an in-memory cohort with latent population structure.
+
+    Samples are split into ``population_structure`` groups with different
+    per-variant allele frequencies, so the PCoA has real signal to find
+    (group separation along PC1) — making end-to-end output qualitatively
+    checkable, not just numerically stable.
+
+    ``dropped_contig_every``: every k-th variant is emitted on contig
+    "chrX_alt" and must be dropped by ingest.
+    """
+    rng = np.random.default_rng(seed)
+    regions = parse_references(references)
+    callsets = [
+        Callset(
+            id=f"{variant_set_id}-{i}",
+            name=_sample_name(i),
+            variant_set_id=variant_set_id,
+        )
+        for i in range(n_samples)
+    ]
+    groups = rng.integers(0, population_structure, size=n_samples)
+
+    # Spread variant positions across the configured regions.
+    total_len = sum(end - start for _, start, end in regions)
+    records: List[dict] = []
+    offsets = rng.choice(total_len, size=n_variants, replace=False) if (
+        n_variants <= total_len
+    ) else rng.integers(0, total_len, size=n_variants)
+    offsets = np.sort(offsets)
+
+    for vi in range(n_variants):
+        off = int(offsets[vi])
+        for contig, start, end in regions:
+            if off < end - start:
+                pos = start + off
+                break
+            off -= end - start
+        ref_base = _BASES[rng.integers(0, 4)]
+        alt_base = _BASES[(rng.integers(1, 4) + _BASES.index(ref_base)) % 4]
+        # Per-group allele frequency: structured signal for the PCoA.
+        group_af = rng.beta(0.4, 1.2, size=population_structure)
+        carrier_p = group_af[groups]
+        gts = rng.random(n_samples) < carrier_p
+        reference_name = (
+            "chrX_alt"
+            if dropped_contig_every and vi % dropped_contig_every == 0
+            else contig
+        )
+        calls = [
+            {
+                "callset_id": callsets[s].id,
+                "callset_name": callsets[s].name,
+                "genotype": [1, 1] if (gts[s] and rng.random() < 0.3)
+                else ([0, 1] if gts[s] else [0, 0]),
+            }
+            for s in range(n_samples)
+        ]
+        af = float(gts.mean())
+        records.append(
+            {
+                "reference_name": reference_name,
+                "start": pos,
+                "end": pos + 1,
+                "reference_bases": ref_base,
+                "alternate_bases": [alt_base],
+                "info": {"AF": [f"{af:.6f}"]},
+                "variant_set_id": variant_set_id,
+                "calls": calls,
+            }
+        )
+
+    return FixtureSource(
+        variants=records, callsets=callsets, stats=stats
+    )

@@ -1,0 +1,375 @@
+"""Pluggable streaming sources: fixture, file, service.
+
+The reference streams shards over gRPC from the Google Genomics v1 API
+(``VariantStreamIterator`` / ``ReadStreamIterator`` with STRICT shard
+boundaries, ``VariantsRDD.scala:205-235``). That API is retired, so the
+framework's source abstraction is a small protocol with three
+implementations:
+
+- :class:`FixtureSource` — in-memory records; the hermetic test/benchmark
+  source (the "fake genomics service" SURVEY.md §4 calls for);
+- :class:`JsonlSource` — newline-JSON files on disk (offline cohorts,
+  optionally gzipped), one record per line;
+- a network source can implement the same protocol against any
+  Genomics-v1-compatible server (see ``spark_examples_tpu.bridge``).
+
+All sources enforce the STRICT boundary rule: a record is yielded by exactly
+the shard containing its start coordinate, so no deduplication pass is
+needed downstream — the same guarantee ``ShardBoundary.Requirement.STRICT``
+gives the reference (VariantsRDD.scala:210-211).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+from dataclasses import dataclass
+from typing import Iterable, Iterator, List, Optional, Protocol, Sequence
+
+from spark_examples_tpu.genomics.shards import Shard
+from spark_examples_tpu.genomics.types import Call, Read, Variant
+from spark_examples_tpu.utils.stats import IoStats
+
+__all__ = [
+    "Callset",
+    "VariantSource",
+    "ReadSource",
+    "FixtureSource",
+    "JsonlSource",
+    "variant_from_record",
+    "read_from_record",
+]
+
+
+@dataclass(frozen=True)
+class Callset:
+    """Callset metadata row (SearchCallSetsResponse analog)."""
+
+    id: str
+    name: str
+    variant_set_id: str
+
+
+class VariantSource(Protocol):
+    def list_callsets(self, variant_set_id: str) -> List[Callset]: ...
+
+    def stream_variants(
+        self, variant_set_id: str, shard: Shard
+    ) -> Iterator[Variant]: ...
+
+
+class ReadSource(Protocol):
+    def stream_reads(
+        self, read_group_set_id: str, shard: Shard
+    ) -> Iterator[Read]: ...
+
+
+def variant_from_record(rec: dict) -> Optional[Variant]:
+    """JSON record → Variant (drops non-numeric contigs, like the builder)."""
+    calls = [
+        Call(
+            callset_id=c["callset_id"],
+            callset_name=c.get("callset_name", c["callset_id"]),
+            genotype=tuple(c.get("genotype", ())),
+            genotype_likelihood=(
+                tuple(c["genotype_likelihood"])
+                if c.get("genotype_likelihood")
+                else None
+            ),
+            phaseset=c.get("phaseset", ""),
+            info={k: tuple(v) for k, v in c.get("info", {}).items()},
+        )
+        for c in rec.get("calls", ())
+    ]
+    return Variant.build(
+        rec["reference_name"],
+        rec["start"],
+        rec["end"],
+        rec.get("reference_bases", ""),
+        id=rec.get("id", ""),
+        names=rec.get("names"),
+        alternate_bases=rec.get("alternate_bases"),
+        info=rec.get("info"),
+        created=rec.get("created", 0),
+        variant_set_id=rec.get("variant_set_id", ""),
+        calls=calls,
+    )
+
+
+def _variant_to_record(v: Variant) -> dict:
+    return {
+        "reference_name": v.contig,
+        "start": v.start,
+        "end": v.end,
+        "reference_bases": v.reference_bases,
+        "id": v.id,
+        "names": list(v.names) if v.names else None,
+        "alternate_bases": list(v.alternate_bases)
+        if v.alternate_bases
+        else None,
+        "info": {k: list(val) for k, val in v.info.items()},
+        "created": v.created,
+        "variant_set_id": v.variant_set_id,
+        "calls": [
+            {
+                "callset_id": c.callset_id,
+                "callset_name": c.callset_name,
+                "genotype": list(c.genotype),
+                "genotype_likelihood": list(c.genotype_likelihood)
+                if c.genotype_likelihood
+                else None,
+                "phaseset": c.phaseset,
+                "info": {k: list(val) for k, val in c.info.items()},
+            }
+            for c in (v.calls or ())
+        ],
+    }
+
+
+def read_from_record(rec: dict) -> Read:
+    return Read.build(
+        rec["reference_name"],
+        rec["position"],
+        rec.get("aligned_sequence", ""),
+        cigar_ops=rec.get("cigar_ops", ()),
+        aligned_quality=rec.get("aligned_quality", ()),
+        id=rec.get("id", ""),
+        mapping_quality=rec.get("mapping_quality", 0),
+        mate_position=rec.get("mate_position", -1),
+        mate_reference_name=rec.get("mate_reference_name", ""),
+        fragment_name=rec.get("fragment_name", ""),
+        read_group_set_id=rec.get("read_group_set_id", ""),
+        info=rec.get("info"),
+        fragment_length=rec.get("fragment_length", 0),
+    )
+
+
+def _strip_chr(name: str) -> str:
+    return name[3:] if name.startswith("chr") else name
+
+
+def _in_shard(reference_name: str, start: int, shard: Shard) -> bool:
+    """STRICT boundary: record's start coordinate inside the shard window.
+
+    Contig comparison is on the *raw* reference name with the lenient
+    matching the API applies — "chr17" and "17" address the same contig, in
+    either direction (shard spec and record may each carry the prefix).
+    """
+    if _strip_chr(shard.contig) != _strip_chr(reference_name):
+        return False
+    return shard.start <= start < shard.end
+
+
+class FixtureSource:
+    """In-memory fake genomics service.
+
+    Holds raw JSON-shaped records (dicts) or already-built objects; streaming
+    goes through the same builder path as real ingest so contig-drop and
+    STRICT-boundary semantics are exercised. Counts into an :class:`IoStats`
+    exactly where the reference's accumulators are fed
+    (VariantsRDD.scala:199-203, 214, 218-221).
+    """
+
+    def __init__(
+        self,
+        variants: Sequence = (),
+        callsets: Sequence[Callset] = (),
+        reads: Sequence = (),
+        stats: Optional[IoStats] = None,
+        fail_shards: Sequence[Shard] = (),
+    ):
+        self._variants = list(variants)
+        self._callsets = list(callsets)
+        self._reads = list(reads)
+        self.stats = stats if stats is not None else IoStats()
+        # Fault injection: shards that raise on first stream attempt —
+        # exercises the retry/elasticity path the reference delegates to
+        # Spark task re-execution.
+        self._fail_once = set(fail_shards)
+
+    def list_callsets(self, variant_set_id: str) -> List[Callset]:
+        self.stats.add(requests=1)
+        return [
+            c for c in self._callsets if c.variant_set_id == variant_set_id
+        ]
+
+    def stream_variants(
+        self, variant_set_id: str, shard: Shard
+    ) -> Iterator[Variant]:
+        self.stats.add(
+            partitions=1, requests=1, reference_bases=shard.range
+        )
+        if shard in self._fail_once:
+            self._fail_once.discard(shard)
+            self.stats.add(io_exceptions=1)
+            raise IOError(f"injected stream failure for {shard}")
+        for item in self._variants:
+            if isinstance(item, Variant):
+                v = item
+                raw_name, start = v.contig, v.start
+            else:
+                if variant_set_id and item.get("variant_set_id", variant_set_id) != variant_set_id:
+                    continue
+                raw_name, start = item["reference_name"], item["start"]
+                v = None
+            if not _in_shard(raw_name, start, shard):
+                continue
+            if v is None:
+                v = variant_from_record(item)
+                if v is None:  # dropped contig
+                    continue
+            if variant_set_id and v.variant_set_id and v.variant_set_id != variant_set_id:
+                continue
+            self.stats.add(variants_read=1)
+            yield v
+
+    def stream_reads(
+        self, read_group_set_id: str, shard: Shard
+    ) -> Iterator[Read]:
+        self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
+        for item in self._reads:
+            r = item if isinstance(item, Read) else read_from_record(item)
+            if (
+                read_group_set_id
+                and r.read_group_set_id
+                and r.read_group_set_id != read_group_set_id
+            ):
+                continue
+            if not _in_shard(r.reference_name, r.position, shard):
+                continue
+            self.stats.add(reads_read=1)
+            yield r
+
+    def dump(self, root: str) -> None:
+        """Write the cohort as a JSONL directory readable by JsonlSource.
+
+        Keeps the interchange schema in one module with its reader
+        (:func:`variant_from_record` / :func:`read_from_record`).
+        """
+        os.makedirs(root, exist_ok=True)
+        with open(os.path.join(root, "callsets.json"), "w") as f:
+            json.dump(
+                [
+                    {
+                        "id": c.id,
+                        "name": c.name,
+                        "variant_set_id": c.variant_set_id,
+                    }
+                    for c in self._callsets
+                ],
+                f,
+            )
+        with open(os.path.join(root, "variants.jsonl"), "w") as f:
+            for rec in self._variants:
+                if isinstance(rec, Variant):
+                    rec = _variant_to_record(rec)
+                f.write(json.dumps(rec) + "\n")
+        if self._reads:
+            with open(os.path.join(root, "reads.jsonl"), "w") as f:
+                for rec in self._reads:
+                    if isinstance(rec, Read):
+                        raise TypeError(
+                            "dump() requires raw read records (dicts)"
+                        )
+                    f.write(json.dumps(rec) + "\n")
+
+
+class JsonlSource:
+    """Newline-JSON cohort on disk: ``<dir>/callsets.json`` +
+    ``<dir>/variants.jsonl[.gz]`` (+ optional ``reads.jsonl[.gz]``).
+
+    The offline-ingest path (the reference's ``--input-path`` objectFile
+    snapshot analog lives one level up, in checkpointing; this is a *source*
+    — a portable interchange format for cohorts).
+    """
+
+    def __init__(self, root: str, stats: Optional[IoStats] = None):
+        self.root = root
+        self.stats = stats if stats is not None else IoStats()
+        # Parsed-record index: a manifest has O(thousands) of shards
+        # (--all-references at 1M bases/shard ≈ 2,900), so re-reading —
+        # or even re-scanning — the whole file once per shard would make
+        # ingest O(shards × records). Parse once into per-contig lists
+        # sorted by start; each shard reads its [start, end) slice via
+        # binary search.
+        self._variant_index: Optional[dict] = None
+        self._read_index: Optional[dict] = None
+
+    def _open(self, name: str):
+        path = os.path.join(self.root, name)
+        if os.path.exists(path + ".gz"):
+            return gzip.open(path + ".gz", "rt")
+        return open(path, "rt")
+
+    @staticmethod
+    def _build_index(f, pos_field: str) -> dict:
+        by_contig: dict = {}
+        for line in f:
+            rec = json.loads(line)
+            by_contig.setdefault(_strip_chr(rec["reference_name"]), []).append(
+                rec
+            )
+        for recs in by_contig.values():
+            recs.sort(key=lambda r: r[pos_field])
+        return by_contig
+
+    def _shard_slice(self, index: dict, pos_field: str, shard: Shard) -> list:
+        import bisect
+
+        recs = index.get(_strip_chr(shard.contig), [])
+        starts = [r[pos_field] for r in recs]
+        lo = bisect.bisect_left(starts, shard.start)
+        hi = bisect.bisect_left(starts, shard.end)
+        return recs[lo:hi]
+
+    def _variants_index(self) -> dict:
+        if self._variant_index is None:
+            with self._open("variants.jsonl") as f:
+                self._variant_index = self._build_index(f, "start")
+        return self._variant_index
+
+    def _reads_index(self) -> dict:
+        if self._read_index is None:
+            with self._open("reads.jsonl") as f:
+                self._read_index = self._build_index(f, "position")
+        return self._read_index
+
+    def list_callsets(self, variant_set_id: str) -> List[Callset]:
+        self.stats.add(requests=1)
+        with self._open("callsets.json") as f:
+            rows = json.load(f)
+        return [
+            Callset(r["id"], r["name"], r.get("variant_set_id", ""))
+            for r in rows
+            if not variant_set_id
+            or r.get("variant_set_id", variant_set_id) == variant_set_id
+        ]
+
+    def stream_variants(
+        self, variant_set_id: str, shard: Shard
+    ) -> Iterator[Variant]:
+        self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
+        for rec in self._shard_slice(self._variants_index(), "start", shard):
+            if (
+                variant_set_id
+                and rec.get("variant_set_id", variant_set_id)
+                != variant_set_id
+            ):
+                continue
+            v = variant_from_record(rec)
+            if v is None:
+                continue
+            self.stats.add(variants_read=1)
+            yield v
+
+    def stream_reads(
+        self, read_group_set_id: str, shard: Shard
+    ) -> Iterator[Read]:
+        self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
+        for rec in self._shard_slice(self._reads_index(), "position", shard):
+            rgs = rec.get("read_group_set_id", "")
+            if rgs and read_group_set_id and rgs != read_group_set_id:
+                continue
+            self.stats.add(reads_read=1)
+            yield read_from_record(rec)

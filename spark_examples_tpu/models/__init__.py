@@ -1,0 +1,1 @@
+"""The pipelines ("apps"): PCA driver and the search examples — L3 parity."""

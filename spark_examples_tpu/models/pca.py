@@ -1,0 +1,194 @@
+"""The PCoA pipeline driver — the north-star component.
+
+TPU re-architecture of ``VariantsPcaDriver`` (``VariantsPca.scala:36-246``)
+with the same public stage surface — get_data / filter_dataset / get_calls /
+get_similarity_matrix / compute_pca / emit_result / report_io_stats — but a
+fundamentally different execution model:
+
+- Spark RDD lineage → plain host generators (ingest is IO-bound; no shuffle);
+- per-task Breeze N×N accumulation + reduceByKey shuffle of N² entries →
+  ``G += X_blk @ X_blk.T`` on the MXU, variant axis streamed, G resident in
+  HBM (``VariantsPca.scala:170-191`` becomes
+  :func:`spark_examples_tpu.ops.gramian_blockwise`);
+- driver collect/broadcast row sums + per-row centering
+  (``VariantsPca.scala:198-223``) → one fused ``double_center`` jit;
+- MLlib RowMatrix.computePrincipalComponents (eig on the driver JVM,
+  ``VariantsPca.scala:225-226``) → ``jnp.linalg.eigh`` on device (or host
+  float64 with ``--precise``), using the |λ|-ordering equivalence documented
+  in :mod:`spark_examples_tpu.ops.pcoa`.
+
+Output is byte-format compatible with ``emitResult``
+(``VariantsPca.scala:233-246``): stdout ``name\tdataset\tpc1\tpc2`` sorted by
+name; ``--output-path`` writes ``<path>-pca.tsv`` lines
+``name\tpc1\tpc2\tdataset``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_examples_tpu.arrays.blocks import blocks_from_calls
+from spark_examples_tpu.genomics.callsets import CallsetIndex
+from spark_examples_tpu.genomics.datasets import af_filter, calls_stream
+from spark_examples_tpu.genomics.shards import SexChromosomeFilter
+from spark_examples_tpu.genomics.types import Variant
+from spark_examples_tpu.ops import (
+    gramian_blockwise,
+    mllib_principal_components_reference,
+    pcoa,
+)
+from spark_examples_tpu.utils.config import PcaConfig
+
+__all__ = ["VariantsPcaDriver"]
+
+
+class VariantsPcaDriver:
+    def __init__(self, conf: PcaConfig, source, mesh=None):
+        self.conf = conf
+        self.source = source
+        self.mesh = mesh
+        self.index = CallsetIndex.from_source(source, conf.variant_set_ids)
+
+    # -- stage 1: ingest -----------------------------------------------------
+
+    def get_data(self) -> List[Iterator[Variant]]:
+        """One lazy variant stream per configured variantset.
+
+        The analog of ``VariantsCommon.data`` (VariantsCommon.scala:52-66):
+        nothing is fetched until the Gramian pass consumes the streams.
+        """
+        shards = self.conf.shards(
+            all_references=self.conf.all_references,
+            sex_filter=SexChromosomeFilter.EXCLUDE_XY,
+        )
+
+        def stream(vsid: str) -> Iterator[Variant]:
+            for shard in shards:
+                yield from self.source.stream_variants(vsid, shard)
+
+        return [stream(vsid) for vsid in self.conf.variant_set_ids]
+
+    # -- stage 2: filters ----------------------------------------------------
+
+    def filter_dataset(self, data: Iterable[Variant]) -> Iterator[Variant]:
+        if self.conf.min_allele_frequency is not None:
+            print(f"Min allele frequency {self.conf.min_allele_frequency}.")
+        return af_filter(data, self.conf.min_allele_frequency)
+
+    # -- stage 3: calls ------------------------------------------------------
+
+    def get_calls(
+        self, streams: Sequence[Iterable[Variant]]
+    ) -> Iterator[List[int]]:
+        """Per-variant carrying-sample index lists (the RDD[Seq[Int]]
+        interface at VariantsPca.scala:153-168)."""
+        if self.conf.debug_datasets:
+            streams = [self._debug_wrap(s) for s in streams]
+        return calls_stream(list(streams), self.index.indexes)
+
+    @staticmethod
+    def _debug_wrap(stream):
+        for v in stream:
+            alt = "".join(v.alternate_bases or ())
+            print(
+                f"{v.contig}: ({v.start}, {v.end}) "
+                f"ref={v.reference_bases or ''} alt={alt}"
+            )
+            yield v
+
+    # -- stage 4: the Gramian ------------------------------------------------
+
+    def get_similarity_matrix(self, calls: Iterable[List[int]]):
+        """Stream call blocks through the device accumulator → (N, N) G."""
+        n = self.index.size
+        blocks = blocks_from_calls(calls, n, self.conf.block_variants)
+        if self.mesh is not None:
+            from spark_examples_tpu.parallel.sharded import (
+                sharded_gramian_blockwise,
+            )
+
+            return sharded_gramian_blockwise(blocks, n, self.mesh)
+        return gramian_blockwise(blocks, n)
+
+    # -- stage 5: eigendecomposition ----------------------------------------
+
+    def compute_pca(self, g) -> List[Tuple[str, float, float]]:
+        import jax.numpy as jnp
+
+        # Row sums reduce on device (mesh collectives when sharded); only
+        # the N-vector reaches the host for the parity print.
+        row_sums = np.asarray(jnp.sum(jnp.asarray(g), axis=1))
+        nonzero = int((row_sums > 0).sum())
+        print(
+            f"Non zero rows in matrix: {nonzero} / {self.index.size}."
+        )  # VariantsPca.scala:207-208
+        if self.conf.precise:
+            # Host-f64 LAPACK path: implies N is gatherable (the reference
+            # gathered the whole matrix to its driver JVM at any N).
+            coords, _ = mllib_principal_components_reference(
+                np.asarray(g), self.conf.num_pc
+            )
+        elif self.mesh is not None:
+            from spark_examples_tpu.parallel.sharded import sharded_pcoa
+
+            coords, _ = sharded_pcoa(g, self.conf.num_pc, self.mesh)
+            coords = np.asarray(coords)
+        else:
+            coords, _ = pcoa(g, self.conf.num_pc)
+            coords = np.asarray(coords)
+        callset_ids = self.index.callset_of_index()
+        # The reference emits exactly two components regardless of --num-pc
+        # (VariantsPca.scala:228-230: array(i), array(i + numRows)).
+        pc2 = coords[:, 1] if coords.shape[1] > 1 else np.zeros(len(coords))
+        return [
+            (callset_ids[i], float(coords[i, 0]), float(pc2[i]))
+            for i in range(self.index.size)
+        ]
+
+    # -- stage 6: emission ---------------------------------------------------
+
+    def emit_result(self, result: Sequence[Tuple[str, float, float]]) -> None:
+        with_names = [
+            (
+                self.index.names[cid],
+                pc1,
+                pc2,
+                cid.split("-")[0],  # dataset label, VariantsPca.scala:235
+            )
+            for cid, pc1, pc2 in result
+        ]
+        for name, pc1, pc2, dataset in sorted(with_names):
+            print(f"{name}\t{dataset}\t{pc1}\t{pc2}")
+        if self.conf.output_path:
+            path = self.conf.output_path + "-pca.tsv"
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "w") as f:
+                for name, pc1, pc2, dataset in sorted(with_names):
+                    f.write(f"{name}\t{pc1}\t{pc2}\t{dataset}\n")
+
+    # -- observability -------------------------------------------------------
+
+    def report_io_stats(self) -> None:
+        stats = getattr(self.source, "stats", None)
+        if stats is not None:
+            print(stats.report())
+
+    def stop(self) -> None:
+        """No cluster to tear down (sc.stop parity no-op)."""
+
+    # -- orchestration -------------------------------------------------------
+
+    def run(self) -> List[Tuple[str, float, float]]:
+        """main() stage order — VariantsPca.scala:38-50."""
+        data = self.get_data()
+        filtered = [self.filter_dataset(d) for d in data]
+        calls = self.get_calls(filtered)
+        g = self.get_similarity_matrix(calls)
+        result = self.compute_pca(g)
+        self.emit_result(result)
+        self.report_io_stats()
+        self.stop()
+        return result

@@ -47,8 +47,7 @@ def gramian(x, compute_dtype=jnp.float32, accum_dtype=jnp.float32):
       ``(n_samples, n_samples)`` symmetric co-occurrence matrix.
     """
     xf = x.astype(compute_dtype)
-    g = jnp.einsum("nv,mv->nm", xf, xf, preferred_element_type=accum_dtype)
-    return g.astype(accum_dtype)
+    return jnp.einsum("nv,mv->nm", xf, xf, preferred_element_type=accum_dtype)
 
 
 @partial(jax.jit, static_argnames=("compute_dtype",), donate_argnums=(0,))
@@ -62,9 +61,7 @@ def gramian_accumulate(g, x_block, compute_dtype=jnp.float32):
     accumulator updates in place in HBM.
     """
     xf = x_block.astype(compute_dtype)
-    return g + jnp.einsum(
-        "nv,mv->nm", xf, xf, preferred_element_type=g.dtype
-    ).astype(g.dtype)
+    return g + jnp.einsum("nv,mv->nm", xf, xf, preferred_element_type=g.dtype)
 
 
 def gramian_blockwise(

@@ -1,0 +1,43 @@
+"""Mesh + collectives: the distributed substrate.
+
+Replaces the reference's entire Spark runtime surface (shuffle, broadcast,
+accumulators, driver collects — SURVEY.md §2.10) with XLA collectives over a
+``jax.sharding.Mesh``: GSPMD inserts all-gathers for the 2D-sharded Gramian,
+``psum`` over the variant axis replaces ``reduceByKey``, and
+``jax.distributed`` over DCN replaces driver⇄executor control.
+
+Axis conventions:
+
+- ``"data"`` — the variant axis (the "long sequence" of genomics, millions
+  of variants): blocks are scattered across it and partial Gramians are
+  psum-reduced. This is the framework's sequence/context parallelism.
+- ``"model"`` — the sample axis: the N×N Gramian and the genotype rows are
+  sharded across it when N is large (tensor parallelism; the 100k-sample
+  stress config).
+"""
+
+from spark_examples_tpu.parallel.mesh import make_mesh, DATA_AXIS, MODEL_AXIS
+from spark_examples_tpu.parallel.sharded import (
+    gramian_variant_parallel,
+    sharded_gramian_blockwise,
+    sharded_pcoa,
+    topk_eig_randomized,
+)
+from spark_examples_tpu.parallel.distributed import (
+    initialize_from_env,
+    is_coordinator,
+    allreduce_host_stats,
+)
+
+__all__ = [
+    "make_mesh",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "gramian_variant_parallel",
+    "sharded_gramian_blockwise",
+    "sharded_pcoa",
+    "topk_eig_randomized",
+    "initialize_from_env",
+    "is_coordinator",
+    "allreduce_host_stats",
+]

@@ -1,0 +1,78 @@
+"""Multi-host coordination over DCN — the jax.distributed layer.
+
+The reference's driver⇄executor control plane (Spark master, task
+scheduling, accumulator merging) maps onto ``jax.distributed``: one process
+per host, ``jax.distributed.initialize`` over DCN, process 0 as the
+"driver" for metadata/emission, and device collectives for anything
+numeric. Host-side counters merge with an explicit all-reduce
+(:func:`allreduce_host_stats`) — the accumulator story.
+
+Single-host (including the one-chip bench and the CPU test mesh) is the
+no-op fast path throughout: nothing here requires a cluster.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from spark_examples_tpu.utils.stats import IoStats
+
+__all__ = ["initialize_from_env", "is_coordinator", "allreduce_host_stats"]
+
+
+def initialize_from_env() -> bool:
+    """Initialize jax.distributed when a cluster env is present.
+
+    Recognizes the standard coordinator variables (JAX_COORDINATOR_ADDRESS /
+    num processes / process id, or cloud-TPU auto-detection via
+    ``jax.distributed.initialize()`` no-arg form when
+    ``SPARK_EXAMPLES_TPU_MULTIHOST=1``). Returns True if distributed mode
+    was initialized.
+    """
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize(
+            coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+            num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+            process_id=int(os.environ["JAX_PROCESS_ID"]),
+        )
+        return True
+    if os.environ.get("SPARK_EXAMPLES_TPU_MULTIHOST") == "1":
+        jax.distributed.initialize()
+        return True
+    return False
+
+
+def is_coordinator() -> bool:
+    """Process 0 plays the reference's "driver" role (emission, metadata)."""
+    return jax.process_index() == 0
+
+
+def allreduce_host_stats(stats: IoStats) -> IoStats:
+    """Merge per-host IoStats across processes into global totals.
+
+    Single-process: identity. Multi-process: all-gather the counter vector
+    through the devices (the accumulator merge the Spark driver did).
+    """
+    if jax.process_count() == 1:
+        return stats
+    from jax.experimental import multihost_utils
+
+    vec = np.asarray(stats.as_vector(), dtype=np.int64)
+    total = np.asarray(
+        multihost_utils.process_allgather(vec)
+    ).sum(axis=0)
+    merged = IoStats()
+    merged.add(
+        partitions=int(total[0]),
+        reference_bases=int(total[1]),
+        requests=int(total[2]),
+        unsuccessful_responses=int(total[3]),
+        io_exceptions=int(total[4]),
+        variants_read=int(total[5]),
+        reads_read=int(total[6]),
+    )
+    return merged

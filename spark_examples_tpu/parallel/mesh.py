@@ -1,0 +1,42 @@
+"""Device-mesh construction — the topology half of ``--mesh-shape``.
+
+The CLI spec grammar is ``"axis:size[,axis:size...]"`` (e.g. ``"data:4"``,
+``"data:4,model:2"``), replacing the reference's ``--spark-master`` /
+``--num-reduce-partitions`` knobs (GenomicsConf.scala:42-45,52-53): instead
+of naming a cluster and a reducer count, name how devices factor over the
+variant ("data") and sample ("model") axes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "DATA_AXIS", "MODEL_AXIS"]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    spec: Optional[str] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build a Mesh from a spec string; default = all devices on "data"."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if not spec:
+        return Mesh(np.array(devices), (DATA_AXIS,))
+    names, sizes = [], []
+    for part in spec.split(","):
+        name, size = part.strip().split(":")
+        names.append(name)
+        sizes.append(int(size))
+    want = int(np.prod(sizes))
+    if want > len(devices):
+        raise ValueError(
+            f"mesh spec {spec!r} needs {want} devices, have {len(devices)}"
+        )
+    arr = np.array(devices[:want]).reshape(sizes)
+    return Mesh(arr, tuple(names))

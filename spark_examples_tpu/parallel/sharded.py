@@ -1,0 +1,172 @@
+"""Sharded Gramian + PCoA under pjit/shard_map.
+
+Two parallelism regimes, matching SURVEY.md §2.10's strategy table:
+
+- **Variant-parallel (the DP/sequence-parallel analog).** V is huge, N
+  moderate (the 1000-Genomes configs): each device holds a slice of the
+  variant axis, computes a local partial ``X_loc @ X_loc.T``, and partial
+  Gramians are ``psum``-reduced over the ring — the TPU-native replacement
+  for the reference's per-task Breeze matrices + ``reduceByKey`` shuffle
+  (VariantsPca.scala:184-191). Implemented with ``shard_map`` so the
+  collective is explicit.
+
+- **Sample-sharded (the TP analog).** N is huge (the synthetic 100k-sample
+  stress config): G (N×N) lives 2D-sharded over (data, model); X rows are
+  sharded and GSPMD inserts the all-gathers for ``X @ X.T``. The
+  eigendecomposition at this scale cannot gather G to one device, so top-k
+  eigenvectors come from :func:`topk_eig_randomized` — randomized subspace
+  iteration whose only O(N²) op is ``C @ Q`` (shardable matmul); the
+  (N, k+p) tall-skinny panel QR is done host-side-small per iteration.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_examples_tpu.ops.centering import double_center
+from spark_examples_tpu.ops.pcoa import normalize_eigvec_signs
+from spark_examples_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+__all__ = [
+    "gramian_variant_parallel",
+    "sharded_gramian_blockwise",
+    "sharded_pcoa",
+    "topk_eig_randomized",
+]
+
+
+def _mesh_axes(mesh: Mesh):
+    has_model = MODEL_AXIS in mesh.axis_names
+    return DATA_AXIS, (MODEL_AXIS if has_model else None)
+
+
+def gramian_variant_parallel(x, mesh: Mesh, compute_dtype=jnp.float32):
+    """``G = psum_over_devices(X_loc @ X_loc.T)`` with X variant-sharded.
+
+    ``x``: (N, V) with V divisible by the data-axis size. Returns G
+    replicated (N small enough to replicate in this regime).
+    """
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=P(None, DATA_AXIS),
+        out_specs=P(None, None),
+    )
+    def _local_gramian(x_loc):
+        xf = x_loc.astype(compute_dtype)
+        g_loc = jnp.einsum(
+            "nv,mv->nm", xf, xf, preferred_element_type=jnp.float32
+        )
+        return jax.lax.psum(g_loc, DATA_AXIS)
+
+    return jax.jit(_local_gramian)(x)
+
+
+def sharded_gramian_blockwise(
+    blocks: Iterable[np.ndarray],
+    n_samples: int,
+    mesh: Mesh,
+    accum_dtype=jnp.float32,
+    compute_dtype=jnp.float32,
+):
+    """Stream variant blocks into a mesh-sharded Gramian accumulator.
+
+    G is laid out P(data, model) — 2D-sharded when the mesh has a model
+    axis, row-sharded otherwise; X blocks arrive row-sharded P(data, None).
+    GSPMD inserts the all-gather of X over the partial axis; accumulation
+    stays in place in HBM (donated).
+    """
+    d_axis, m_axis = _mesh_axes(mesh)
+    g_sharding = NamedSharding(mesh, P(d_axis, m_axis))
+    x_sharding = NamedSharding(mesh, P(d_axis, None))
+
+    # Pad the sample axis to a multiple of the mesh axis sizes: N comes
+    # from the cohort's callset count, which is arbitrary, and device_put
+    # requires the sharded dimension to divide evenly. Zero rows are inert
+    # in X @ X.T (zero rows/cols of G), trimmed before returning.
+    divisor = mesh.shape[d_axis] * (mesh.shape[m_axis] if m_axis else 1)
+    n_padded = -(-n_samples // divisor) * divisor
+
+    @partial(jax.jit, donate_argnums=(0,), out_shardings=g_sharding)
+    def _accum(g, xb):
+        xf = xb.astype(compute_dtype)
+        return g + jnp.einsum(
+            "nv,mv->nm", xf, xf, preferred_element_type=g.dtype
+        )
+
+    g = jax.device_put(
+        jnp.zeros((n_padded, n_padded), dtype=accum_dtype), g_sharding
+    )
+    for block in blocks:
+        xb = np.asarray(block)
+        if n_padded != n_samples:
+            xb = np.pad(xb, ((0, n_padded - n_samples), (0, 0)))
+        g = _accum(g, jax.device_put(xb, x_sharding))
+    return g[:n_samples, :n_samples]
+
+
+def topk_eig_randomized(
+    c,
+    k: int,
+    oversample: int = 8,
+    iters: int = 30,
+    seed: int = 0,
+):
+    """Top-|λ| eigenpairs of symmetric C by randomized subspace iteration.
+
+    The sharded-eig path for N where a dense ``eigh`` is infeasible
+    (SURVEY.md §7 hard-parts #3): every O(N²) op is a matmul against an
+    (N, k+p) panel, which GSPMD shards with C; the per-iteration QR runs on
+    the small replicated panel. Subspace iteration on C converges to the
+    invariant subspace of the largest-|λ| eigenvalues (signs recovered via
+    Rayleigh quotients), which is exactly the MLlib |λ|-ordering
+    (see :mod:`spark_examples_tpu.ops.pcoa`).
+
+    Returns ``(vecs (N,k), vals (k,))`` ordered by |λ| descending, signs
+    normalized.
+    """
+    n = c.shape[0]
+    p = min(n, k + oversample)
+    q0 = jax.random.normal(jax.random.PRNGKey(seed), (n, p), dtype=c.dtype)
+
+    @partial(jax.jit, static_argnames=("iters",))
+    def _run(c, q, iters):
+        def body(q, _):
+            y = c @ q  # the only O(N²) op — sharded with C
+            q, _ = jnp.linalg.qr(y)
+            return q, None
+
+        q, _ = jax.lax.scan(body, q, None, length=iters)
+        # Rayleigh–Ritz on the converged subspace.
+        b = q.T @ (c @ q)  # (p, p) small
+        w, u = jnp.linalg.eigh(b)
+        order = jnp.argsort(-jnp.abs(w))
+        vecs = q @ u[:, order]
+        return vecs, w[order]
+
+    vecs, vals = _run(c, q0, iters)
+    return normalize_eigvec_signs(vecs[:, :k]), vals[:k]
+
+
+def sharded_pcoa(g, k: int, mesh: Mesh, dense_eigh_limit: int = 8192):
+    """Center + top-k eigenvectors of a (possibly mesh-sharded) Gramian.
+
+    Small N: gather the centered matrix and run dense ``eigh`` (exact, the
+    replicated-eigh fallback of SURVEY.md §7). Large N: keep C sharded and
+    use randomized subspace iteration.
+    """
+    c = jax.jit(double_center)(g)
+    n = c.shape[0]
+    if n <= dense_eigh_limit:
+        c = jax.device_put(np.asarray(c))
+        from spark_examples_tpu.ops.pcoa import principal_components
+
+        return principal_components(c, k)
+    return topk_eig_randomized(c, k)

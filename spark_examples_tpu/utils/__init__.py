@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: IO stats, config/flags, checkpointing, logging."""

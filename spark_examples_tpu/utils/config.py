@@ -1,0 +1,156 @@
+"""Flag system: CLI parity with GenomicsConf/PcaConf plus mesh/TPU flags.
+
+Two-level declarative config mirroring the scallop hierarchy
+(``GenomicsConf.scala:31-101``): :class:`GenomicsConfig` carries the common
+flags with the reference defaults (1M bases/shard, BRCA1 region, Platinum
+Genomes set id); :class:`PcaConfig` adds the PCA-driver extras. Spark-only
+knobs (``--num-reduce-partitions``, ``--spark-master``) are accepted for CLI
+compatibility but map onto mesh/topology flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from spark_examples_tpu.arrays.blocks import DEFAULT_BLOCK_VARIANTS
+from spark_examples_tpu.genomics.shards import (
+    BRCA1_REFERENCES,
+    DEFAULT_BASES_PER_SHARD,
+    SexChromosomeFilter,
+    Shard,
+    shards_for_all_references,
+    shards_for_references,
+)
+
+__all__ = ["GenomicsConfig", "PcaConfig", "add_genomics_flags", "add_pca_flags"]
+
+# Reference well-known variantset ids (SearchVariantsExample.scala:27-31).
+PLATINUM_GENOMES = "3049512673186936334"
+THOUSAND_GENOMES_PHASE1 = "10473108253681171589"
+THOUSAND_GENOMES_PHASE3 = "4252737135923902652"
+
+
+@dataclass
+class GenomicsConfig:
+    bases_per_partition: int = DEFAULT_BASES_PER_SHARD
+    client_secrets: Optional[str] = None
+    input_path: Optional[str] = None
+    num_reduce_partitions: int = 10  # accepted for parity; unused by XLA
+    output_path: Optional[str] = None
+    references: str = BRCA1_REFERENCES
+    variant_set_ids: List[str] = field(
+        default_factory=lambda: [PLATINUM_GENOMES]
+    )
+    # TPU-native additions (replace --spark-master):
+    mesh_shape: Optional[str] = None  # e.g. "data:4,model:2"
+    block_variants: int = DEFAULT_BLOCK_VARIANTS
+
+    def shards(
+        self,
+        all_references: bool = False,
+        sex_filter: SexChromosomeFilter = SexChromosomeFilter.EXCLUDE_XY,
+    ) -> List[Shard]:
+        """Partitioner selection — PcaConf.getPartitioner
+        (GenomicsConf.scala:92-100)."""
+        if all_references:
+            return shards_for_all_references(
+                sex_filter, self.bases_per_partition
+            )
+        return shards_for_references(
+            self.references, self.bases_per_partition
+        )
+
+
+@dataclass
+class PcaConfig(GenomicsConfig):
+    all_references: bool = False
+    debug_datasets: bool = False
+    min_allele_frequency: Optional[float] = None
+    num_pc: int = 2
+    precise: bool = False  # host-f64 eigendecomposition (driver-side LAPACK analog)
+
+
+def add_genomics_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--bases-per-partition",
+        type=int,
+        default=DEFAULT_BASES_PER_SHARD,
+        help="Partition each reference using a fixed number of bases",
+    )
+    p.add_argument(
+        "--client-secrets",
+        default=None,
+        help="Accepted for CLI parity; authentication is source-specific",
+    )
+    p.add_argument(
+        "--input-path",
+        default=None,
+        help="Path to a cohort snapshot or JSONL cohort directory "
+        "(replaces the API source)",
+    )
+    p.add_argument(
+        "--num-reduce-partitions",
+        type=int,
+        default=10,
+        help="Accepted for CLI parity (Spark shuffle knob); unused",
+    )
+    p.add_argument("--output-path", default=None)
+    p.add_argument(
+        "--references",
+        default=BRCA1_REFERENCES,
+        help="Comma separated tuples of reference:start:end",
+    )
+    p.add_argument(
+        "--variant-set-id",
+        action="append",
+        dest="variant_set_ids",
+        default=None,
+        help="VariantSet id (repeatable for multi-dataset join/merge)",
+    )
+    p.add_argument(
+        "--mesh-shape",
+        default=None,
+        help="Device mesh, e.g. 'data:4,model:2' (replaces --spark-master)",
+    )
+    p.add_argument(
+        "--block-variants", type=int, default=DEFAULT_BLOCK_VARIANTS
+    )
+
+
+def add_pca_flags(p: argparse.ArgumentParser) -> None:
+    add_genomics_flags(p)
+    p.add_argument(
+        "--all-references",
+        action="store_true",
+        help="Use all the autosomes (overrides --references)",
+    )
+    p.add_argument("--debug-datasets", action="store_true")
+    p.add_argument("--min-allele-frequency", type=float, default=None)
+    p.add_argument("--num-pc", type=int, default=2)
+    p.add_argument(
+        "--precise",
+        action="store_true",
+        help="Eigendecompose on host in float64 (Breeze/LAPACK analog)",
+    )
+
+
+def _config_from_args(cls, args: argparse.Namespace):
+    kwargs = {}
+    for f in cls.__dataclass_fields__:
+        if hasattr(args, f):
+            val = getattr(args, f)
+            if val is not None or f not in ("variant_set_ids",):
+                kwargs[f] = val
+    if kwargs.get("variant_set_ids") is None:
+        kwargs.pop("variant_set_ids", None)
+    return cls(**kwargs)
+
+
+def genomics_config_from_args(args) -> GenomicsConfig:
+    return _config_from_args(GenomicsConfig, args)
+
+
+def pca_config_from_args(args) -> PcaConfig:
+    return _config_from_args(PcaConfig, args)

@@ -1,0 +1,73 @@
+"""Ingest observability — the accumulator system.
+
+Parity with ``VariantsRddStats`` (VariantsRDD.scala:160-180): six named
+counters fed by the data plane and pretty-printed as a block at job end
+(``VariantsCommon.scala:68-73``). Spark merges executor-side accumulators on
+the driver; here counters are per-process (threads share them via atomic
+increments under the GIL) and multi-host totals are merged with an explicit
+all-reduce of the counter vector — see
+:func:`spark_examples_tpu.parallel.distributed.allreduce_host_stats`.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+__all__ = ["IoStats"]
+
+
+@dataclass
+class IoStats:
+    partitions: int = 0
+    reference_bases: int = 0
+    requests: int = 0
+    unsuccessful_responses: int = 0
+    io_exceptions: int = 0
+    variants_read: int = 0
+    reads_read: int = 0
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
+    def add(self, **deltas: int) -> None:
+        with self._lock:
+            for name, d in deltas.items():
+                setattr(self, name, getattr(self, name) + d)
+
+    def merge(self, other: "IoStats") -> None:
+        self.add(
+            partitions=other.partitions,
+            reference_bases=other.reference_bases,
+            requests=other.requests,
+            unsuccessful_responses=other.unsuccessful_responses,
+            io_exceptions=other.io_exceptions,
+            variants_read=other.variants_read,
+            reads_read=other.reads_read,
+        )
+
+    def as_vector(self):
+        """Counter vector for device-side psum merging across hosts."""
+        return [
+            self.partitions,
+            self.reference_bases,
+            self.requests,
+            self.unsuccessful_responses,
+            self.io_exceptions,
+            self.variants_read,
+            self.reads_read,
+        ]
+
+    def report(self) -> str:
+        """The formatted block of VariantsRDD.scala:168-180."""
+        return (
+            "Variants API stats\n"
+            "------------------\n"
+            f"# of partitions: {self.partitions}\n"
+            f"# of reference bases requested: {self.reference_bases}\n"
+            f"# of API requests: {self.requests}\n"
+            f"# of unsuccessful responses: {self.unsuccessful_responses}\n"
+            f"# of IO exceptions: {self.io_exceptions}\n"
+            f"# of variants read: {self.variants_read}\n"
+            f"# of reads read: {self.reads_read}\n"
+        )

@@ -1,0 +1,63 @@
+"""Bridge tests: the PcaBackend seam over a real socket."""
+
+import numpy as np
+
+from spark_examples_tpu.bridge import (
+    PcaBridgeClient,
+    PcaBridgeServer,
+    TpuPcaBackend,
+)
+from spark_examples_tpu.ops import gramian, mllib_principal_components_reference
+
+
+def _random_calls(n, v, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        list(rng.choice(n, size=rng.integers(1, n), replace=False))
+        for _ in range(v)
+    ]
+
+
+def _golden(calls, n, k):
+    x = np.zeros((n, len(calls)))
+    for col, idx in enumerate(calls):
+        x[idx, col] = 1
+    return mllib_principal_components_reference(x @ x.T, k)[0]
+
+
+def test_inprocess_backend_matches_golden():
+    calls = _random_calls(17, 120)
+    coords, eigvals = TpuPcaBackend(block_variants=32).compute(
+        iter(calls), 17, 2
+    )
+    np.testing.assert_allclose(coords, _golden(calls, 17, 2), atol=1e-4)
+    assert eigvals.shape == (2,)
+
+
+def test_socket_bridge_round_trip():
+    calls = _random_calls(11, 60, seed=2)
+    server = PcaBridgeServer(TpuPcaBackend(block_variants=16)).start()
+    try:
+        client = PcaBridgeClient(port=server.port)
+        coords, _ = client.compute(calls, 11, 2, batch_size=7)
+        client.close()
+        np.testing.assert_allclose(coords, _golden(calls, 11, 2), atol=1e-4)
+    finally:
+        server.stop()
+
+
+def test_bridge_error_on_missing_init():
+    import json
+    import socket
+
+    server = PcaBridgeServer(TpuPcaBackend()).start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", server.port))
+        f = sock.makefile("rwb")
+        f.write(b'{"cmd": "finish"}\n')
+        f.flush()
+        resp = json.loads(f.readline())
+        assert "error" in resp
+        sock.close()
+    finally:
+        server.stop()

@@ -1,0 +1,202 @@
+"""Source/STRICT-boundary/stats/datasets tests over the hermetic fixture."""
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.genomics import FixtureSource, Shard
+from spark_examples_tpu.genomics.callsets import CallsetIndex
+from spark_examples_tpu.genomics.datasets import (
+    af_filter,
+    calls_stream,
+    carrying_sample_indices,
+    join_datasets,
+    merge_datasets,
+)
+from spark_examples_tpu.genomics.fixtures import synthetic_cohort
+from spark_examples_tpu.genomics.shards import shards_for_references
+from spark_examples_tpu.genomics.sources import Callset, JsonlSource
+from spark_examples_tpu.genomics.types import Call, Variant
+
+
+def _variant(contig, start, vsid="vs1", calls=(), **kw):
+    return Variant.build(
+        contig,
+        start,
+        start + 1,
+        "A",
+        alternate_bases=["G"],
+        variant_set_id=vsid,
+        calls=calls,
+        **kw,
+    )
+
+
+def _call(cid, gt):
+    return Call(cid, cid, tuple(gt))
+
+
+class TestStrictShardBoundary:
+    def test_variant_in_exactly_one_shard(self):
+        # A variant whose range straddles a shard boundary is yielded only
+        # by the shard containing its START (STRICT semantics,
+        # VariantsRDD.scala:210-211).
+        src = FixtureSource(
+            variants=[
+                {
+                    "reference_name": "17",
+                    "start": 999_999,
+                    "end": 1_000_050,
+                    "reference_bases": "A" * 51,
+                    "calls": [],
+                }
+            ]
+        )
+        shards = shards_for_references("17:0:2000000", 1_000_000)
+        hits = [
+            v
+            for s in shards
+            for v in src.stream_variants("", s)
+        ]
+        assert len(hits) == 1
+        assert hits[0].start == 999_999
+
+    def test_chr_prefix_matching(self):
+        src = FixtureSource(
+            variants=[
+                {"reference_name": "chr17", "start": 5, "end": 6, "calls": []}
+            ]
+        )
+        (v,) = src.stream_variants("", Shard("17", 0, 10))
+        assert v.contig == "17"
+
+    def test_dropped_contig_not_streamed(self):
+        src = FixtureSource(
+            variants=[
+                {"reference_name": "chrX_alt", "start": 5, "end": 6},
+                {"reference_name": "17", "start": 5, "end": 6},
+            ]
+        )
+        out = list(src.stream_variants("", Shard("17", 0, 10)))
+        assert len(out) == 1
+
+    def test_stats_accumulate(self):
+        src = synthetic_cohort(10, 50)
+        shards = shards_for_references("17:41196311:41277499", 30_000)
+        n = sum(len(list(src.stream_variants("", s))) for s in shards)
+        assert n == 50
+        assert src.stats.variants_read == 50
+        assert src.stats.partitions == len(shards)
+        assert src.stats.reference_bases == sum(s.range for s in shards)
+
+    def test_fault_injection_then_retry(self):
+        shard = Shard("17", 41196311, 41277499)
+        src = synthetic_cohort(4, 10)
+        src._fail_once.add(shard)
+        with pytest.raises(IOError):
+            list(src.stream_variants("", shard))
+        # Deterministic manifest → idempotent re-ingest succeeds.
+        assert len(list(src.stream_variants("", shard))) == 10
+        assert src.stats.io_exceptions == 1
+
+
+class TestCallsetIndex:
+    def test_dense_index_across_sets(self):
+        src = FixtureSource(
+            callsets=[
+                Callset("a", "S1", "vs1"),
+                Callset("b", "S2", "vs1"),
+                Callset("c", "S3", "vs2"),
+            ]
+        )
+        idx = CallsetIndex.from_source(src, ["vs1", "vs2"])
+        assert idx.size == 3
+        assert idx.indexes == {"a": 0, "b": 1, "c": 2}
+        assert idx.name_of_index() == ["S1", "S2", "S3"]
+
+
+class TestDatasets:
+    def test_af_filter(self):
+        vs = [
+            _variant("17", 1, info={"AF": ("0.05",)}),
+            _variant("17", 2, info={"AF": ("0.5",)}),
+            _variant("17", 3),  # no AF → dropped
+        ]
+        kept = list(af_filter(vs, 0.1))
+        assert [v.start for v in kept] == [2]
+        assert len(list(af_filter(vs, None))) == 3
+
+    def test_carrying_sample_indices(self):
+        v = _variant(
+            "17",
+            1,
+            calls=[_call("a", (0, 1)), _call("b", (0, 0)), _call("c", (1, 1))],
+        )
+        assert carrying_sample_indices(v, {"a": 0, "b": 1, "c": 2}) == [0, 2]
+
+    def test_join_two_datasets(self):
+        idx = {"a": 0, "b": 1}
+        set1 = [
+            _variant("17", 1, calls=[_call("a", (0, 1))]),
+            _variant("17", 9, calls=[_call("a", (1, 1))]),
+        ]
+        set2 = [_variant("17", 1, calls=[_call("b", (0, 1))])]
+        out = list(join_datasets(set1, set2, idx))
+        # Only position 1 is shared; calls concatenated.
+        assert out == [[0, 1]]
+
+    def test_merge_requires_presence_in_all(self):
+        idx = {"a": 0, "b": 1, "c": 2}
+        s1 = [_variant("17", 1, calls=[_call("a", (0, 1))])]
+        s2 = [_variant("17", 1, calls=[_call("b", (0, 1))])]
+        s3 = [
+            _variant("17", 1, calls=[_call("c", (0, 1))]),
+            _variant("17", 2, calls=[_call("c", (0, 1))]),
+        ]
+        out = list(merge_datasets([s1, s2, s3], idx))
+        assert sorted(out[0]) == [0, 1, 2]
+        assert len(out) == 1  # position 2 present in only one set
+
+    def test_calls_stream_drops_empty(self):
+        idx = {"a": 0}
+        vs = [
+            _variant("17", 1, calls=[_call("a", (0, 0))]),  # no variation
+            _variant("17", 2, calls=[_call("a", (0, 1))]),
+        ]
+        assert list(calls_stream([vs], idx)) == [[0]]
+
+
+class TestJsonlRoundTrip:
+    def test_jsonl_source(self, tmp_path):
+        import json
+
+        src = synthetic_cohort(6, 20)
+        (tmp_path / "callsets.json").write_text(
+            json.dumps(
+                [
+                    {"id": c.id, "name": c.name, "variant_set_id": c.variant_set_id}
+                    for c in src._callsets
+                ]
+            )
+        )
+        with open(tmp_path / "variants.jsonl", "w") as f:
+            for rec in src._variants:
+                f.write(json.dumps(rec) + "\n")
+
+        jsrc = JsonlSource(str(tmp_path))
+        idx = CallsetIndex.from_source(jsrc, [src._callsets[0].variant_set_id])
+        assert idx.size == 6
+        shard = Shard("17", 41196311, 41277499)
+        a = [v.start for v in jsrc.stream_variants("", shard)]
+        b = [v.start for v in src.stream_variants("", shard)]
+        assert a == b and len(a) == 20
+
+
+class TestChrPrefixSymmetry:
+    def test_shard_spec_with_chr_prefix_matches_bare_records(self):
+        src = FixtureSource(
+            variants=[
+                {"reference_name": "17", "start": 5, "end": 6, "calls": []}
+            ]
+        )
+        (v,) = src.stream_variants("", Shard("chr17", 0, 10))
+        assert v.start == 5

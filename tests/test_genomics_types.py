@@ -1,0 +1,141 @@
+"""Unit tests for the messy-bit semantics (SURVEY.md §7 hard-parts #4)."""
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.genomics import (
+    Call,
+    Read,
+    Variant,
+    normalize_contig,
+    has_variation,
+    murmur3_x64_128,
+    variant_identity,
+)
+from spark_examples_tpu.genomics.shards import (
+    SexChromosomeFilter,
+    Shard,
+    manifest_digest,
+    parse_references,
+    shards_for_all_references,
+    shards_for_references,
+    HUMAN_CHROMOSOMES,
+)
+
+
+class TestContigNormalization:
+    """VariantsRDD.scala:103-110 — regex ([a-z]*)?([0-9]*), full match."""
+
+    def test_chr_prefix_stripped(self):
+        assert normalize_contig("chr17") == "17"
+
+    def test_bare_numeric_kept(self):
+        assert normalize_contig("17") == "17"
+
+    def test_uppercase_x_dropped(self):
+        assert normalize_contig("chrX") is None
+        assert normalize_contig("chrY") is None
+        assert normalize_contig("chrM") is None
+
+    def test_alt_contigs_dropped(self):
+        assert normalize_contig("HLA-DRB1*15:01:01:01") is None
+        assert normalize_contig("GL000207.1") is None
+        assert normalize_contig("chr17_ctg5_hap1") is None
+
+    def test_builder_drops_bad_contig(self):
+        assert Variant.build("chrUn_gl000211", 5, 6, "A") is None
+        v = Variant.build("chr13", 5, 6, "A")
+        assert v is not None and v.contig == "13"
+
+
+class TestHasVariation:
+    def test_hom_ref_false(self):
+        assert not has_variation(Call("c", "n", (0, 0)))
+
+    def test_het_true(self):
+        assert has_variation(Call("c", "n", (0, 1)))
+
+    def test_no_call_false(self):
+        assert not has_variation(Call("c", "n", (-1, -1)))
+
+    def test_empty_genotype_false(self):
+        assert not has_variation(Call("c", "n", ()))
+
+
+class TestMurmur3:
+    def test_known_vectors(self):
+        # Public MurmurHash3 x64-128 test vectors (smhasher / guava).
+        assert murmur3_x64_128(b"").hex() == "00000000000000000000000000000000"
+        # Self-consistency: same input → same output, distinct inputs differ.
+        a = murmur3_x64_128(b"The quick brown fox")
+        b = murmur3_x64_128(b"The quick brown fox.")
+        assert a != b and len(a) == 16
+
+    def test_block_boundaries(self):
+        # Exercise tail lengths 0..16 around the 16-byte block edge.
+        seen = set()
+        for n in range(33):
+            seen.add(murmur3_x64_128(bytes(range(n))))
+        assert len(seen) == 33
+
+    def test_variant_identity_fields_matter(self):
+        base = variant_identity("17", 100, 101, "A", ("G",))
+        assert variant_identity("17", 100, 101, "A", ("T",)) != base
+        assert variant_identity("17", 101, 102, "A", ("G",)) != base
+        assert variant_identity("13", 100, 101, "A", ("G",)) != base
+        # None handling: null referenceBases → "" (VariantsPca.scala:66).
+        assert variant_identity("17", 100, 101, None, None) == variant_identity(
+            "17", 100, 101, "", ()
+        )
+
+
+class TestShards:
+    def test_parse_references(self):
+        assert parse_references("17:41196311:41277499,13:1:10") == [
+            ("17", 41196311, 41277499),
+            ("13", 1, 10),
+        ]
+
+    def test_fixed_windows(self):
+        shards = shards_for_references("1:0:2500000", 1_000_000)
+        assert [(s.start, s.end) for s in shards] == [
+            (0, 1000000),
+            (1000000, 2000000),
+            (2000000, 2500000),
+        ]
+
+    def test_all_references_excludes_xy_for_variants(self):
+        shards = shards_for_all_references(SexChromosomeFilter.EXCLUDE_XY)
+        contigs = {s.contig for s in shards}
+        assert "X" not in contigs and "Y" not in contigs
+        assert contigs == {str(i) for i in range(1, 23)}
+
+    def test_all_references_includes_xy_for_reads(self):
+        contigs = {
+            s.contig
+            for s in shards_for_all_references(SexChromosomeFilter.INCLUDE_XY)
+        }
+        assert "X" in contigs and "Y" in contigs
+
+    def test_total_coverage(self):
+        shards = shards_for_all_references(SexChromosomeFilter.INCLUDE_XY)
+        total = sum(s.range for s in shards)
+        assert total == sum(HUMAN_CHROMOSOMES.values())
+
+    def test_manifest_digest_stable(self):
+        a = shards_for_references("17:0:5000000")
+        b = shards_for_references("17:0:5000000")
+        assert manifest_digest(a) == manifest_digest(b)
+        assert manifest_digest(a) != manifest_digest(a[:-1])
+
+
+class TestReadBuild:
+    def test_cigar_assembly(self):
+        r = Read.build(
+            "21",
+            1000,
+            "ACGT",
+            cigar_ops=[("CLIP_SOFT", 2), ("ALIGNMENT_MATCH", 98), ("SKIP", 5)],
+        )
+        assert r.cigar == "2S98M5N"
+        assert r.key() == ("21", 1000)

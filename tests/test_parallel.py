@@ -1,0 +1,163 @@
+"""Sharding tests on the virtual 8-device CPU mesh (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from spark_examples_tpu.ops import gramian, double_center, principal_components
+from spark_examples_tpu.parallel import (
+    gramian_variant_parallel,
+    make_mesh,
+    sharded_gramian_blockwise,
+    sharded_pcoa,
+    topk_eig_randomized,
+)
+
+
+@pytest.fixture
+def x_small():
+    rng = np.random.default_rng(0)
+    return (rng.random((32, 256)) < 0.3).astype(np.int8)
+
+
+class TestMesh:
+    def test_default_mesh_all_devices(self):
+        mesh = make_mesh()
+        assert mesh.devices.size == 8
+        assert mesh.axis_names == ("data",)
+
+    def test_spec_mesh(self):
+        mesh = make_mesh("data:4,model:2")
+        assert mesh.shape == {"data": 4, "model": 2}
+
+    def test_oversized_spec_rejected(self):
+        with pytest.raises(ValueError, match="needs 16"):
+            make_mesh("data:16")
+
+
+class TestShardedGramian:
+    def test_variant_parallel_matches_dense(self, x_small):
+        mesh = make_mesh("data:8")
+        g = gramian_variant_parallel(jnp.asarray(x_small), mesh)
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(gramian(x_small))
+        )
+
+    def test_blockwise_sharded_matches_dense_1d(self, x_small):
+        mesh = make_mesh("data:8")
+        blocks = [x_small[:, i : i + 64] for i in range(0, 256, 64)]
+        g = sharded_gramian_blockwise(blocks, 32, mesh)
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(gramian(x_small))
+        )
+
+    def test_blockwise_sharded_matches_dense_2d(self, x_small):
+        mesh = make_mesh("data:4,model:2")
+        blocks = [x_small[:, i : i + 64] for i in range(0, 256, 64)]
+        g = sharded_gramian_blockwise(blocks, 32, mesh)
+        # G must actually be laid out across the mesh.
+        assert len(g.sharding.device_set) == 8
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(gramian(x_small))
+        )
+
+
+class TestShardedEig:
+    def test_randomized_topk_matches_eigh(self):
+        rng = np.random.default_rng(5)
+        q, _ = np.linalg.qr(rng.random((64, 64)))
+        w = np.linspace(50, 0.01, 64) * np.sign(rng.random(64) - 0.2)
+        c = (q * w) @ q.T
+        c = np.asarray(double_center(c), dtype=np.float32)
+
+        exact_v, exact_w = principal_components(c, 3)
+        rand_v, rand_w = topk_eig_randomized(jnp.asarray(c), 3, iters=60)
+        np.testing.assert_allclose(
+            np.asarray(rand_w), np.asarray(exact_w), rtol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.abs(np.asarray(rand_v)), np.abs(np.asarray(exact_v)), atol=1e-3
+        )
+
+    def test_sharded_pcoa_dense_path(self, x_small):
+        mesh = make_mesh("data:4,model:2")
+        blocks = [x_small[:, i : i + 64] for i in range(0, 256, 64)]
+        g = sharded_gramian_blockwise(blocks, 32, mesh)
+        coords, w = sharded_pcoa(g, 2, mesh)
+        golden, _ = principal_components(
+            np.asarray(double_center(np.asarray(gramian(x_small)))), 2
+        )
+        np.testing.assert_allclose(
+            np.asarray(coords), np.asarray(golden), atol=1e-4
+        )
+
+    def test_sharded_pcoa_randomized_path(self, x_small):
+        mesh = make_mesh("data:4,model:2")
+        g = gramian(x_small)
+        g = jax.device_put(
+            g, NamedSharding(mesh, P("data", "model"))
+        )
+        coords, w = sharded_pcoa(g, 2, mesh, dense_eigh_limit=8)
+        golden, _ = principal_components(
+            np.asarray(double_center(np.asarray(gramian(x_small)))), 2
+        )
+        np.testing.assert_allclose(
+            np.abs(np.asarray(coords)), np.abs(golden), atol=1e-2
+        )
+
+
+class TestDriverWithMesh:
+    def test_pca_driver_sharded(self):
+        from spark_examples_tpu.genomics.fixtures import (
+            DEFAULT_VARIANT_SET_ID,
+            synthetic_cohort,
+        )
+        from spark_examples_tpu.models.pca import VariantsPcaDriver
+        from spark_examples_tpu.utils.config import PcaConfig
+
+        conf = PcaConfig(
+            variant_set_ids=[DEFAULT_VARIANT_SET_ID], block_variants=64
+        )
+        mesh = make_mesh("data:4,model:2")
+        source = synthetic_cohort(24, 200)
+        result = VariantsPcaDriver(conf, source, mesh=mesh).run()
+
+        conf2 = PcaConfig(variant_set_ids=[DEFAULT_VARIANT_SET_ID])
+        unsharded = VariantsPcaDriver(
+            conf2, synthetic_cohort(24, 200)
+        ).run()
+        a = np.array([r[1:] for r in result])
+        b = np.array([r[1:] for r in unsharded])
+        np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+class TestReviewRegressions:
+    def test_sharded_gramian_nondivisible_n(self):
+        """N=23 on an 8-way mesh: padding must make the mesh path work for
+        arbitrary cohort sizes."""
+        rng = np.random.default_rng(9)
+        x = (rng.random((23, 128)) < 0.3).astype(np.int8)
+        mesh = make_mesh("data:4,model:2")
+        blocks = [x[:, i : i + 32] for i in range(0, 128, 32)]
+        g = sharded_gramian_blockwise(blocks, 23, mesh)
+        assert g.shape == (23, 23)
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(gramian(x)))
+
+    def test_driver_mesh_uses_sharded_pcoa_nondivisible(self):
+        from spark_examples_tpu.genomics.fixtures import (
+            DEFAULT_VARIANT_SET_ID,
+            synthetic_cohort,
+        )
+        from spark_examples_tpu.models.pca import VariantsPcaDriver
+        from spark_examples_tpu.utils.config import PcaConfig
+
+        conf = PcaConfig(
+            variant_set_ids=[DEFAULT_VARIANT_SET_ID], block_variants=64
+        )
+        mesh = make_mesh("data:8")
+        result = VariantsPcaDriver(
+            conf, synthetic_cohort(23, 150), mesh=mesh
+        ).run()
+        assert len(result) == 23
