@@ -14,6 +14,7 @@ import sys
 from spark_examples_tpu.genomics.fixtures import (
     DEFAULT_VARIANT_SET_ID,
     synthetic_cohort,
+    synthetic_reads,
 )
 from spark_examples_tpu.genomics.sources import JsonlSource
 from spark_examples_tpu.utils.config import (
@@ -85,6 +86,136 @@ def _cmd_generate_fixture(args) -> int:
     return 0
 
 
+def _resolve_reads_source(args, references: str):
+    """Returns (source, read_group_set_id)."""
+    from spark_examples_tpu.genomics.fixtures import FIXTURE_READSET_ID
+    from spark_examples_tpu.models.search_reads import Examples
+
+    if args.input_path:
+        return JsonlSource(args.input_path), Examples.GOOGLE_EXAMPLE_READSET
+    if args.fixture_reads:
+        return (
+            synthetic_reads(
+                args.fixture_reads,
+                references=references,
+                seed=args.fixture_seed,
+            ),
+            FIXTURE_READSET_ID,
+        )
+    raise SystemExit(
+        "No reads source: pass --input-path <jsonl cohort dir> or "
+        "--fixture-reads N"
+    )
+
+
+def _cmd_search_variants(args, fn) -> int:
+    conf = pca_config_from_args(args)
+    if not args.variant_set_ids:
+        conf.variant_set_ids = [DEFAULT_VARIANT_SET_ID]
+    source = _resolve_source(args, args.references)
+    fn(
+        source,
+        variant_set_id=conf.variant_set_ids[0],
+        references=args.references,
+        bases_per_shard=conf.bases_per_partition,
+    )
+    return 0
+
+
+def _cmd_reads_example(args) -> int:
+    from spark_examples_tpu.models import search_reads as sr
+
+    n = args.example
+    if n == 1:
+        refs = args.references or (
+            f"11:{sr.Examples.CILANTRO - 1000}:{sr.Examples.CILANTRO + 1000}"
+        )
+        source, rgsid = _resolve_reads_source(args, refs)
+        for line in sr.pileup(
+            source,
+            rgsid,
+            references=refs,
+            bases_per_shard=args.bases_per_partition,
+        ):
+            print(line)
+    elif n == 2:
+        refs = args.references  # None → whole chr21, reference behavior
+        source, rgsid = _resolve_reads_source(args, refs or "21:1:48129895")
+        sr.average_coverage(
+            source,
+            rgsid,
+            references=refs,
+            bases_per_shard=args.bases_per_partition,
+        )
+    elif n == 3:
+        refs = args.references
+        source, rgsid = _resolve_reads_source(args, refs or "21:1:48129895")
+        out = sr.per_base_depth_example(
+            source,
+            rgsid,
+            references=refs,
+            out_path=args.output_path or ".",
+            bases_per_shard=args.bases_per_partition,
+        )
+        print(f"Wrote {out}")
+    elif n == 4:
+        refs = args.references or "1:100000000:101000000"
+        if args.input_path:
+            source = JsonlSource(args.input_path)
+            normal_id = sr.Examples.GOOGLE_DREAM_SET3_NORMAL
+            tumor_id = sr.Examples.GOOGLE_DREAM_SET3_TUMOR
+        elif args.fixture_reads:
+            from spark_examples_tpu.genomics.fixtures import (
+                NORMAL_READSET_ID,
+                TUMOR_READSET_ID,
+                synthetic_tumor_normal,
+            )
+
+            source = synthetic_tumor_normal(
+                args.fixture_reads, references=refs, seed=args.fixture_seed
+            )
+            normal_id, tumor_id = NORMAL_READSET_ID, TUMOR_READSET_ID
+        else:
+            raise SystemExit(
+                "No reads source: pass --input-path or --fixture-reads N"
+            )
+        out = sr.tumor_normal_diff(
+            source,
+            normal_id=normal_id,
+            tumor_id=tumor_id,
+            references=refs,
+            out_path=args.output_path or ".",
+            bases_per_shard=args.bases_per_partition,
+        )
+        print(f"Wrote {out}")
+    else:
+        raise SystemExit(f"unknown reads example {n}")
+    return 0
+
+
+def _cmd_pca_bridge(args) -> int:
+    """Serve the PcaBackend seam over TCP."""
+    from spark_examples_tpu.bridge import PcaBridgeServer, TpuPcaBackend
+
+    mesh = None
+    if args.mesh_shape:
+        from spark_examples_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(args.mesh_shape)
+    server = PcaBridgeServer(
+        TpuPcaBackend(mesh=mesh, block_variants=args.block_variants),
+        port=args.port,
+    ).start()
+    print(f"PcaBackend bridge listening on 127.0.0.1:{server.port}")
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="spark_examples_tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -101,6 +232,47 @@ def build_parser() -> argparse.ArgumentParser:
     _add_fixture_flags(gen)
     gen.add_argument("--out", required=True)
     gen.set_defaults(fn=_cmd_generate_fixture)
+
+    from spark_examples_tpu.models.search_variants import (
+        search_variants_brca1,
+        search_variants_klotho,
+    )
+
+    from spark_examples_tpu.genomics.shards import (
+        BRCA1_REFERENCES,
+        KLOTHO_REFERENCES,
+    )
+
+    for name, fn, refs in (
+        ("search-variants-klotho", search_variants_klotho, KLOTHO_REFERENCES),
+        ("search-variants-brca1", search_variants_brca1, BRCA1_REFERENCES),
+    ):
+        sv = sub.add_parser(name, help=f"{name} example driver")
+        add_pca_flags(sv)
+        _add_fixture_flags(sv)
+        sv.set_defaults(references=refs)
+        sv.set_defaults(fn=lambda a, _f=fn: _cmd_search_variants(a, _f))
+
+    reads = sub.add_parser(
+        "reads-example", help="SearchReadsExample 1-4 drivers"
+    )
+    add_pca_flags(reads)
+    _add_fixture_flags(reads)
+    reads.add_argument("--example", type=int, required=True, choices=[1, 2, 3, 4])
+    reads.add_argument(
+        "--fixture-reads",
+        type=int,
+        default=None,
+        help="Run against synthetic reads",
+    )
+    reads.set_defaults(references=None, fn=_cmd_reads_example)
+
+    bridge = sub.add_parser(
+        "pca-bridge", help="Serve the PcaBackend seam over TCP"
+    )
+    add_pca_flags(bridge)
+    bridge.add_argument("--port", type=int, default=18717)
+    bridge.set_defaults(fn=_cmd_pca_bridge)
 
     return p
 

@@ -17,9 +17,18 @@ import numpy as np
 from spark_examples_tpu.genomics.sources import Callset, FixtureSource
 from spark_examples_tpu.genomics.shards import BRCA1_REFERENCES, parse_references
 
-__all__ = ["synthetic_cohort", "DEFAULT_VARIANT_SET_ID"]
+__all__ = [
+    "synthetic_cohort",
+    "synthetic_reads",
+    "synthetic_tumor_normal",
+    "DEFAULT_VARIANT_SET_ID",
+    "FIXTURE_READSET_ID",
+    "NORMAL_READSET_ID",
+    "TUMOR_READSET_ID",
+]
 
 DEFAULT_VARIANT_SET_ID = "fixture-platinum"
+FIXTURE_READSET_ID = "fixture-readset"
 
 _BASES = ("A", "C", "G", "T")
 
@@ -112,3 +121,111 @@ def synthetic_cohort(
     return FixtureSource(
         variants=records, callsets=callsets, stats=stats
     )
+
+
+def synthetic_reads(
+    n_reads: int,
+    references: str = "11:6888648:6890648",
+    read_len: int = 100,
+    read_group_set_id: str = FIXTURE_READSET_ID,
+    seed: int = 0,
+    variant_positions: Optional[dict] = None,
+    mean_quality: int = 35,
+    stats=None,
+) -> FixtureSource:
+    """Generate aligned reads over a region from a latent haplotype.
+
+    A deterministic reference haplotype is drawn for the region; reads copy
+    it with ~1% base error, so per-position base-frequency tables have
+    realistic consensus structure. ``variant_positions`` maps absolute
+    position → (alt_base, fraction): that fraction of covering reads carry
+    the alt — the tumor/normal injection hook for the Example-4 pipeline
+    (reference DREAM synthetic set analog, SearchReadsExample.scala:171+).
+    """
+    rng = np.random.default_rng(seed)
+    regions = parse_references(references)
+    contig, start, end = regions[0]
+    region_len = end - start
+    haplotype = rng.integers(0, 4, size=region_len)
+    variant_positions = variant_positions or {}
+
+    records: List[dict] = []
+    for ri in range(n_reads):
+        pos = start + int(rng.integers(0, max(1, region_len - read_len)))
+        codes = haplotype[pos - start : pos - start + read_len].copy()
+        errs = rng.random(read_len) < 0.01
+        codes[errs] = rng.integers(0, 4, size=int(errs.sum()))
+        for vpos, (alt, frac) in variant_positions.items():
+            off = vpos - pos
+            if 0 <= off < read_len and rng.random() < frac:
+                codes[off] = _BASES.index(alt)
+        seq = "".join(_BASES[c] for c in codes)
+        quals = np.clip(
+            rng.normal(mean_quality, 5, size=read_len).astype(int), 2, 60
+        )
+        records.append(
+            {
+                "reference_name": contig,
+                "position": pos,
+                "aligned_sequence": seq,
+                "aligned_quality": quals.tolist(),
+                "cigar_ops": [("ALIGNMENT_MATCH", read_len)],
+                "mapping_quality": int(
+                    np.clip(rng.normal(50, 15), 0, 60)
+                ),
+                "fragment_name": f"read-{ri}",
+                "read_group_set_id": read_group_set_id,
+            }
+        )
+    return FixtureSource(reads=records, stats=stats)
+
+
+NORMAL_READSET_ID = "fixture-normal"
+TUMOR_READSET_ID = "fixture-tumor"
+
+
+def synthetic_tumor_normal(
+    n_reads: int,
+    references: str = "1:100000000:100002000",
+    seed: int = 0,
+    n_somatic: int = 3,
+    somatic_fraction: float = 0.6,
+    stats=None,
+) -> FixtureSource:
+    """Two readsets over the same haplotype, tumor carrying somatic variants.
+
+    The hermetic stand-in for the DREAM synthetic tumor/normal pair
+    (SearchReadsExample.scala:35-37): identical seed → identical latent
+    haplotype, with ``n_somatic`` positions where ``somatic_fraction`` of
+    tumor reads carry an alternate base — the signal Example 4's diff
+    pipeline must recover.
+    """
+    rng = np.random.default_rng(seed + 1)
+    contig, start, end = parse_references(references)[0]
+    # Replay synthetic_reads' haplotype draw (same seed, first draw) so the
+    # somatic alt is guaranteed to differ from the reference base.
+    haplotype = np.random.default_rng(seed).integers(0, 4, size=end - start)
+    margin = min(200, (end - start) // 4)
+    somatic = {}
+    while len(somatic) < n_somatic:
+        pos = int(rng.integers(start + margin, end - margin))
+        alt = (int(haplotype[pos - start]) + int(rng.integers(1, 4))) % 4
+        somatic[pos] = (_BASES[alt], somatic_fraction)
+    normal = synthetic_reads(
+        n_reads,
+        references=references,
+        read_group_set_id=NORMAL_READSET_ID,
+        seed=seed,
+    )
+    tumor = synthetic_reads(
+        n_reads,
+        references=references,
+        read_group_set_id=TUMOR_READSET_ID,
+        seed=seed,
+        variant_positions=somatic,
+    )
+    merged = FixtureSource(
+        reads=normal._reads + tumor._reads, stats=stats
+    )
+    merged.somatic_positions = sorted(somatic)  # for tests
+    return merged

@@ -304,21 +304,23 @@ class JsonlSource:
 
     @staticmethod
     def _build_index(f, pos_field: str) -> dict:
+        """contig → (sorted start-position list, records sorted by start)."""
         by_contig: dict = {}
         for line in f:
             rec = json.loads(line)
             by_contig.setdefault(_strip_chr(rec["reference_name"]), []).append(
                 rec
             )
-        for recs in by_contig.values():
+        out = {}
+        for contig, recs in by_contig.items():
             recs.sort(key=lambda r: r[pos_field])
-        return by_contig
+            out[contig] = ([r[pos_field] for r in recs], recs)
+        return out
 
     def _shard_slice(self, index: dict, pos_field: str, shard: Shard) -> list:
         import bisect
 
-        recs = index.get(_strip_chr(shard.contig), [])
-        starts = [r[pos_field] for r in recs]
+        starts, recs = index.get(_strip_chr(shard.contig), ([], []))
         lo = bisect.bisect_left(starts, shard.start)
         hi = bisect.bisect_left(starts, shard.end)
         return recs[lo:hi]

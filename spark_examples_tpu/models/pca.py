@@ -28,6 +28,7 @@ from __future__ import annotations
 import os
 from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
 from spark_examples_tpu.arrays.blocks import blocks_from_calls
@@ -101,17 +102,85 @@ class VariantsPcaDriver:
 
     # -- stage 4: the Gramian ------------------------------------------------
 
-    def get_similarity_matrix(self, calls: Iterable[List[int]]):
-        """Stream call blocks through the device accumulator → (N, N) G."""
+    def _blocks_to_gramian(self, blocks, g_init=None):
         n = self.index.size
-        blocks = blocks_from_calls(calls, n, self.conf.block_variants)
         if self.mesh is not None:
             from spark_examples_tpu.parallel.sharded import (
                 sharded_gramian_blockwise,
             )
 
-            return sharded_gramian_blockwise(blocks, n, self.mesh)
-        return gramian_blockwise(blocks, n)
+            g = sharded_gramian_blockwise(blocks, n, self.mesh)
+        else:
+            g = gramian_blockwise(blocks, n)
+        if g_init is not None:
+            g = g + jax.numpy.asarray(g_init, dtype=g.dtype)
+        return g
+
+    def get_similarity_matrix(self, calls: Iterable[List[int]]):
+        """Stream call blocks through the device accumulator → (N, N) G."""
+        blocks = blocks_from_calls(
+            calls, self.index.size, self.conf.block_variants
+        )
+        return self._blocks_to_gramian(blocks)
+
+    def get_similarity_matrix_checkpointed(self):
+        """Shard-group ingest with incremental (G, cursor) snapshots.
+
+        Resume semantics (SURVEY.md §5 checkpoint/resume, done better than
+        the reference's all-or-nothing objectFile): the deterministic
+        manifest + idempotent per-shard ingest make skipping completed
+        shards exact. Single-dataset only — N-way merge needs global
+        identity state that cannot be cut at shard boundaries.
+        """
+        from spark_examples_tpu.utils.checkpoint import (
+            load_snapshot,
+            save_snapshot,
+        )
+        from spark_examples_tpu.genomics.shards import manifest_digest
+
+        assert len(self.conf.variant_set_ids) == 1, (
+            "checkpointed ingest supports a single variantset"
+        )
+        vsid = self.conf.variant_set_ids[0]
+        shards = self.conf.shards(
+            all_references=self.conf.all_references,
+            sex_filter=SexChromosomeFilter.EXCLUDE_XY,
+        )
+        # The snapshot key covers everything that determines G's content:
+        # the shard manifest, the dataset, and the AF filter.
+        digest = (
+            f"{manifest_digest(shards)}|{vsid}"
+            f"|af={self.conf.min_allele_frequency}"
+        )
+        n = self.index.size
+        ck = load_snapshot(self.conf.checkpoint_dir, digest, n)
+        done = ck.shards_done if ck else 0
+        if ck:
+            print(f"Resuming from snapshot: {done}/{len(shards)} shards done.")
+        g = ck.g if ck else None
+
+        every = max(1, self.conf.checkpoint_every)
+        while done < len(shards):
+            group = shards[done : done + every]
+
+            def group_calls():
+                for shard in group:
+                    stream = self.filter_dataset(
+                        self.source.stream_variants(vsid, shard)
+                    )
+                    yield from calls_stream([stream], self.index.indexes)
+
+            blocks = blocks_from_calls(
+                group_calls(), n, self.conf.block_variants
+            )
+            g = self._blocks_to_gramian(blocks, g_init=g)
+            done += len(group)
+            save_snapshot(self.conf.checkpoint_dir, g, done, digest)
+        return (
+            g
+            if g is not None
+            else self._blocks_to_gramian(iter(()))
+        )
 
     # -- stage 5: eigendecomposition ----------------------------------------
 
@@ -183,12 +252,26 @@ class VariantsPcaDriver:
 
     def run(self) -> List[Tuple[str, float, float]]:
         """main() stage order — VariantsPca.scala:38-50."""
-        data = self.get_data()
-        filtered = [self.filter_dataset(d) for d in data]
-        calls = self.get_calls(filtered)
-        g = self.get_similarity_matrix(calls)
-        result = self.compute_pca(g)
-        self.emit_result(result)
+        from spark_examples_tpu.utils.tracing import StageTimer, profiler_trace
+
+        timer = StageTimer()
+        with profiler_trace(self.conf.trace_dir):
+            with timer.stage("ingest+gramian"):
+                if (
+                    self.conf.checkpoint_dir
+                    and len(self.conf.variant_set_ids) == 1
+                ):
+                    g = self.get_similarity_matrix_checkpointed()
+                else:
+                    data = self.get_data()
+                    filtered = [self.filter_dataset(d) for d in data]
+                    calls = self.get_calls(filtered)
+                    g = self.get_similarity_matrix(calls)
+            with timer.stage("pca"):
+                result = self.compute_pca(g)
+            with timer.stage("emit"):
+                self.emit_result(result)
         self.report_io_stats()
+        print(timer.report())
         self.stop()
         return result

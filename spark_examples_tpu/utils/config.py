@@ -70,6 +70,9 @@ class PcaConfig(GenomicsConfig):
     min_allele_frequency: Optional[float] = None
     num_pc: int = 2
     precise: bool = False  # host-f64 eigendecomposition (driver-side LAPACK analog)
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 64  # shards per Gramian snapshot
+    trace_dir: Optional[str] = None  # jax.profiler trace output
 
 
 def add_genomics_flags(p: argparse.ArgumentParser) -> None:
@@ -133,6 +136,17 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         "--precise",
         action="store_true",
         help="Eigendecompose on host in float64 (Breeze/LAPACK analog)",
+    )
+    p.add_argument(
+        "--checkpoint-dir",
+        default=None,
+        help="Directory for incremental Gramian snapshots (resume support)",
+    )
+    p.add_argument("--checkpoint-every", type=int, default=64)
+    p.add_argument(
+        "--trace-dir",
+        default=None,
+        help="Write a jax.profiler trace of the run here",
     )
 
 

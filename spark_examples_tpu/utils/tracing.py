@@ -1,0 +1,54 @@
+"""Tracing/profiling: jax.profiler capture + per-stage wall-clock.
+
+The reference has no custom tracing (drivers just set log4j to WARN and
+lean on the Spark UI — SURVEY.md §5); the TPU framework does better: an
+optional ``jax.profiler`` trace (viewable in TensorBoard/Perfetto) around
+any region, plus a lightweight stage timer whose report is the wall-clock
+decomposition of a pipeline run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+__all__ = ["StageTimer", "profiler_trace"]
+
+
+class StageTimer:
+    """Accumulates wall-clock per named stage; prints a report block."""
+
+    def __init__(self) -> None:
+        self.seconds: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] = (
+                self.seconds.get(name, 0.0) + time.perf_counter() - t0
+            )
+
+    def report(self) -> str:
+        total = sum(self.seconds.values())
+        lines = ["Stage wall-clock", "----------------"]
+        for name, secs in self.seconds.items():
+            pct = 100.0 * secs / total if total else 0.0
+            lines.append(f"{name}: {secs:.3f}s ({pct:.1f}%)")
+        lines.append(f"total: {total:.3f}s")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """``jax.profiler.trace`` when a directory is given, no-op otherwise."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

@@ -1,0 +1,238 @@
+"""Reads kernels + the four example drivers (SearchReadsExample parity)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.genomics.fixtures import (
+    NORMAL_READSET_ID,
+    TUMOR_READSET_ID,
+    synthetic_reads,
+    synthetic_tumor_normal,
+)
+from spark_examples_tpu.models.search_reads import (
+    Examples,
+    average_coverage,
+    per_base_depth_example,
+    pileup,
+    tumor_normal_diff,
+)
+from spark_examples_tpu.ops.reads_ops import (
+    base_frequency_table,
+    encode_bases,
+    per_base_depth,
+)
+
+
+class TestKernels:
+    def test_per_base_depth_vs_scalar(self):
+        rng = np.random.default_rng(0)
+        region = 500
+        starts = rng.integers(-50, region, size=64).astype(np.int32)
+        lengths = rng.integers(1, 120, size=64).astype(np.int32)
+        lengths[5] = 0  # padding slot
+        got = np.asarray(per_base_depth(starts, lengths, region))
+        want = np.zeros(region, np.int32)
+        for s, l in zip(starts, lengths):
+            for p in range(max(0, s), min(region, s + l)):
+                want[p] += 1
+        np.testing.assert_array_equal(got, want)
+
+    def test_encode_bases(self):
+        np.testing.assert_array_equal(
+            encode_bases("ACGTNacgtnX"), [0, 1, 2, 3, 4, 0, 1, 2, 3, 4, 4]
+        )
+
+    def test_base_frequency_table_vs_scalar(self):
+        rng = np.random.default_rng(1)
+        region, n, l = 300, 32, 50
+        starts = rng.integers(-10, region, size=n).astype(np.int32)
+        codes = rng.integers(0, 5, size=(n, l)).astype(np.int8)
+        quals = rng.integers(0, 60, size=(n, l)).astype(np.int32)
+        quals[3, :] = -1  # absent qualities → all skipped
+        got = np.asarray(
+            base_frequency_table(starts, codes, quals, 30, region)
+        )
+        want = np.zeros((region, 5), np.int32)
+        for i in range(n):
+            for j in range(l):
+                p = starts[i] + j
+                if 0 <= p < region and quals[i, j] >= 30:
+                    want[p, codes[i, j]] += 1
+        np.testing.assert_array_equal(got, want)
+
+
+class TestPileup:
+    def test_pileup_format(self):
+        snp = Examples.CILANTRO
+        src = synthetic_reads(
+            200, references=f"11:{snp - 1000}:{snp + 1000}", seed=2
+        )
+        lines = pileup(src, 'fixture-readset', snp=snp)
+        assert len(lines) > 2
+        # v/^ markers anchored over the SNP column relative to first read.
+        assert lines[0].endswith("v") and lines[-1].endswith("^")
+        assert lines[0][:-1].strip() == "" and len(lines[0]) == len(lines[-1])
+        # Each read line splices "(qq) " right after the SNP base.
+        v_col = len(lines[0]) - 1
+        for line in lines[1:-1]:
+            assert line[v_col + 1 : v_col + 2] == "("
+            assert line[v_col + 4 : v_col + 6] == ") "
+
+    def test_pileup_empty_region(self):
+        src = synthetic_reads(10, references="11:100:300", seed=0)
+        assert pileup(src, 'fixture-readset', snp=Examples.CILANTRO) == []
+
+
+class TestCoverage:
+    def test_average_coverage(self):
+        src = synthetic_reads(100, references="21:0:10000", read_len=100)
+        cov = average_coverage(src, 'fixture-readset', contig="21", length=10_000)
+        assert cov == pytest.approx(100 * 100 / 10_000)
+
+    def test_depth_file(self, tmp_path):
+        src = synthetic_reads(50, references="21:0:5000", read_len=80, seed=3)
+        out = per_base_depth_example(
+            src, 'fixture-readset', contig="21", length=5000, out_path=str(tmp_path)
+        )
+        lines = open(out).read().strip().split("\n")
+        # Total depth equals total aligned bases (all reads inside region).
+        total = sum(
+            int(l.split(",")[1].rstrip(")")) for l in lines
+        )
+        assert total == 50 * 80
+        # Ascending positions, "(pos,depth)" format.
+        positions = [int(l.split(",")[0][1:]) for l in lines]
+        assert positions == sorted(positions)
+
+
+class TestTumorNormal:
+    def test_diff_recovers_somatic_positions(self, tmp_path):
+        refs = "1:100000000:100002000"
+        src = synthetic_tumor_normal(
+            600, references=refs, seed=7, n_somatic=3, somatic_fraction=0.9
+        )
+        out = tumor_normal_diff(
+            src,
+            normal_id=NORMAL_READSET_ID,
+            tumor_id=TUMOR_READSET_ID,
+            references=refs,
+            out_path=str(tmp_path),
+        )
+        lines = open(out).read().strip().split("\n")
+        found = {int(l.split(",")[0][1:]) for l in lines if l}
+        # Every somatic position with 90% tumor fraction must be found
+        # (noise positions may also appear; somatic must be a subset).
+        assert set(src.somatic_positions) <= found
+
+    def test_no_diff_for_identical_sets(self, tmp_path):
+        refs = "1:100000000:100001000"
+        normal = synthetic_reads(
+            200, references=refs, read_group_set_id="a", seed=5
+        )
+        from spark_examples_tpu.genomics.sources import FixtureSource
+
+        both = FixtureSource(
+            reads=[
+                {**r, "read_group_set_id": rid}
+                for r in normal._reads
+                for rid in ("a", "b")
+            ]
+        )
+        out = tumor_normal_diff(
+            both, "a", "b", references=refs, out_path=str(tmp_path)
+        )
+        assert open(out).read().strip() == ""
+
+
+class TestReadsCli:
+    def test_cli_examples(self, capsys, tmp_path):
+        from spark_examples_tpu.cli.main import main
+
+        snp = Examples.CILANTRO
+        rc = main(
+            [
+                "reads-example",
+                "--example",
+                "1",
+                "--fixture-reads",
+                "50",
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "v" in out and "^" in out
+
+        rc = main(
+            [
+                "reads-example",
+                "--example",
+                "3",
+                "--fixture-reads",
+                "30",
+                "--references",
+                "21:0:4000",
+                "--output-path",
+                str(tmp_path),
+            ]
+        )
+        assert rc == 0
+        assert (tmp_path / "coverage_21" / "part-00000").exists()
+
+        rc = main(
+            [
+                "reads-example",
+                "--example",
+                "4",
+                "--fixture-reads",
+                "200",
+                "--references",
+                "1:100000000:100001000",
+                "--output-path",
+                str(tmp_path),
+            ]
+        )
+        assert rc == 0
+        assert (tmp_path / "diff_1" / "part-00000").exists()
+
+
+class TestShardBoundaryCarry:
+    def test_depth_independent_of_shard_size(self, tmp_path):
+        """A read straddling shard boundaries must contribute every base
+        regardless of --bases-per-partition (overhang carry)."""
+        src = synthetic_reads(40, references="21:0:5000", read_len=90, seed=11)
+        outs = []
+        for i, bps in enumerate((5000, 1000, 256)):
+            out = per_base_depth_example(
+                src,
+                "fixture-readset",
+                references="21:0:5000",
+                out_path=str(tmp_path / str(i)),
+                bases_per_shard=bps,
+            )
+            outs.append(open(out).read())
+        assert outs[0] == outs[1] == outs[2]
+        total = sum(
+            int(l.split(",")[1].rstrip(")"))
+            for l in outs[0].strip().split("\n")
+        )
+        assert total == 40 * 90
+
+    def test_freq_diff_independent_of_shard_size(self, tmp_path):
+        refs = "1:100000000:100001500"
+        src = synthetic_tumor_normal(
+            400, references=refs, seed=13, somatic_fraction=0.9
+        )
+        contents = []
+        for i, bps in enumerate((1_000_000, 300)):
+            out = tumor_normal_diff(
+                src,
+                NORMAL_READSET_ID,
+                TUMOR_READSET_ID,
+                references=refs,
+                out_path=str(tmp_path / str(i)),
+                bases_per_shard=bps,
+            )
+            contents.append(open(out).read())
+        assert contents[0] == contents[1]

@@ -1,0 +1,87 @@
+"""Search-variants example drivers (SearchVariantsExample parity)."""
+
+import pytest
+
+from spark_examples_tpu.genomics.sources import FixtureSource
+from spark_examples_tpu.models.search_variants import (
+    search_variants_brca1,
+    search_variants_klotho,
+)
+
+
+def _fixture():
+    # Mix of variant records and reference-matching blocks ("N" ref, no
+    # alternates), as the Platinum Genomes gVCF-style sets contain.
+    variants = [
+        {
+            "reference_name": "chr13",
+            "start": 33628137,
+            "end": 33628138,
+            "reference_bases": "T",
+            "alternate_bases": ["G"],
+            "variant_set_id": "vs",
+            "calls": [
+                {"callset_id": "c1", "genotype": [0, 1]},
+            ],
+        },
+        {
+            "reference_name": "chr13",
+            "start": 33628137,
+            "end": 33628200,
+            "reference_bases": "N",
+            "variant_set_id": "vs",
+            "calls": [],
+        },
+        {
+            "reference_name": "chr17",
+            "start": 41196400,
+            "end": 41196401,
+            "reference_bases": "A",
+            "alternate_bases": ["C"],
+            "variant_set_id": "vs",
+        },
+        {
+            "reference_name": "chr17",
+            "start": 41196500,
+            "end": 41196600,
+            "reference_bases": "N",
+            "variant_set_id": "vs",
+        },
+    ]
+    return FixtureSource(variants=variants)
+
+
+def test_klotho_counts_and_roundtrip(capsys):
+    lines = search_variants_klotho(_fixture(), "vs")
+    assert lines[0] == "We have 2 records that overlap Klotho."
+    assert lines[1] == "But only 1 records are of a variant."
+    assert lines[2] == "The other 1 records are reference-matching blocks."
+    assert "Reference: 13 @ 33628137" in lines
+    out = capsys.readouterr().out
+    assert "We have 2 records" in out
+
+
+def test_brca1_counts(capsys):
+    lines = search_variants_brca1(_fixture(), "vs")
+    assert lines[0] == "We have 2 records that overlap BRCA1."
+    # BRCA1 keys the split on referenceBases != "N".
+    assert lines[1] == "But only 1 records are of a variant."
+
+
+def test_cli_search_variants(capsys):
+    from spark_examples_tpu.cli.main import main
+
+    rc = main(
+        [
+            "search-variants-klotho",
+            "--fixture-samples",
+            "5",
+            "--fixture-variants",
+            "3",
+            "--references",
+            "chr13:33628137:33628138",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "records that overlap Klotho" in out
