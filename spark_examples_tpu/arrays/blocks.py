@@ -17,7 +17,17 @@ from typing import Iterable, Iterator, List, Sequence
 
 import numpy as np
 
-__all__ = ["densify_calls", "blocks_from_calls", "DEFAULT_BLOCK_VARIANTS"]
+__all__ = [
+    "densify_calls",
+    "blocks_from_calls",
+    "round_up_multiple",
+    "DEFAULT_BLOCK_VARIANTS",
+]
+
+
+def round_up_multiple(n: int, multiple: int) -> int:
+    """Smallest multiple of ``multiple`` ≥ n (tile/padding arithmetic)."""
+    return -(-n // multiple) * multiple
 
 # 2^13 variant columns per block: at N=2504 samples an int8 block is ~20 MB
 # host-side — large enough to keep the MXU busy, small enough to double
@@ -28,14 +38,51 @@ DEFAULT_BLOCK_VARIANTS = 8192
 def densify_calls(
     calls: Sequence[Sequence[int]], n_samples: int, width: int = None
 ) -> np.ndarray:
-    """Per-variant index lists → one (n_samples, width) 0/1 int8 block."""
+    """Per-variant index lists → one (n_samples, width) 0/1 int8 block.
+
+    Hot host loop of ingest; runs in the native core when built
+    (:mod:`spark_examples_tpu.native`), with this numpy loop as fallback.
+    """
     width = width if width is not None else len(calls)
+    from spark_examples_tpu.native import load
+
+    lib = load()
+    if lib is not None and calls:
+        offsets = np.zeros(len(calls) + 1, dtype=np.int64)
+        for i, c in enumerate(calls):
+            offsets[i + 1] = offsets[i] + len(c)
+        indices = np.fromiter(
+            (s for c in calls for s in c), dtype=np.int64, count=offsets[-1]
+        )
+        _check_indices(indices, n_samples)
+        x = np.zeros((n_samples, width), dtype=np.int8)
+        lib.pack_calls(
+            indices.ctypes.data,
+            offsets.ctypes.data,
+            len(calls),
+            n_samples,
+            width,
+            x.ctypes.data,
+        )
+        return x
     x = np.zeros((n_samples, width), dtype=np.int8)
     for col, sample_indices in enumerate(calls):
         idx = np.asarray(sample_indices, dtype=np.int64)
         if idx.size:
+            _check_indices(idx, n_samples)
             x[idx, col] = 1
     return x
+
+
+def _check_indices(idx: np.ndarray, n_samples: int) -> None:
+    """Out-of-range sample indices mean a corrupt callset index — fail
+    loudly and identically on both the native and fallback paths (the
+    reference throws on unknown callsets too, VariantsPca.scala:59)."""
+    if idx.size and (idx.min() < 0 or idx.max() >= n_samples):
+        bad = idx[(idx < 0) | (idx >= n_samples)][0]
+        raise ValueError(
+            f"sample index {bad} out of range for N={n_samples}"
+        )
 
 
 def blocks_from_calls(

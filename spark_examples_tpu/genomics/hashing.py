@@ -12,9 +12,25 @@ produces: UTF-8 bytes for ``putString``, 8-byte little-endian for
 
 from __future__ import annotations
 
+import ctypes
 from typing import Optional
 
 __all__ = ["murmur3_x64_128", "variant_identity"]
+
+
+_UNRESOLVED = object()
+_native_lib = _UNRESOLVED
+
+
+def _native():
+    # Resolved once; per-variant hashing is a hot loop and must not take a
+    # lock or read os.environ per call.
+    global _native_lib
+    if _native_lib is _UNRESOLVED:
+        from spark_examples_tpu.native import load
+
+        _native_lib = load()
+    return _native_lib
 
 _MASK64 = (1 << 64) - 1
 _C1 = 0x87C37B91114253D5
@@ -35,7 +51,22 @@ def _fmix64(k: int) -> int:
 
 
 def murmur3_x64_128(data: bytes, seed: int = 0) -> bytes:
-    """16-byte MurmurHash3 x64-128 digest (h1 then h2, little-endian)."""
+    """16-byte MurmurHash3 x64-128 digest (h1 then h2, little-endian).
+
+    Dispatches to the native core when built
+    (:mod:`spark_examples_tpu.native`); this Python body is the reference
+    implementation and the fallback, tested byte-identical to the native
+    one.
+    """
+    lib = _native()
+    if lib is not None:
+        out = ctypes.create_string_buffer(16)
+        lib.murmur3_x64_128(data, len(data), seed, out)
+        return out.raw
+    return _murmur3_py(data, seed)
+
+
+def _murmur3_py(data: bytes, seed: int = 0) -> bytes:
     h1 = seed & _MASK64
     h2 = seed & _MASK64
     length = len(data)

@@ -20,6 +20,7 @@ from spark_examples_tpu.genomics.shards import (
     Shard,
     shards_for_references,
 )
+from spark_examples_tpu.arrays.blocks import round_up_multiple
 from spark_examples_tpu.genomics.types import Read
 from spark_examples_tpu.ops.reads_ops import (
     BASE_CODES,
@@ -138,10 +139,6 @@ def _pad_pow2(n: int, floor: int = 256) -> int:
     return p
 
 
-def _round_up(n: int, multiple: int) -> int:
-    return -(-n // multiple) * multiple
-
-
 def _single_region(references: str):
     """The reads examples operate on one contiguous region."""
     from spark_examples_tpu.genomics.shards import parse_references
@@ -217,7 +214,7 @@ def per_base_depth_example(
     out_file = os.path.join(out_dir, "part-00000")
 
     def compute(shard, reads, pad):
-        window = shard.range + _round_up(pad, 128)
+        window = shard.range + round_up_multiple(pad, 128)
         if not reads:
             return np.zeros(window, np.int64)
         n_pad = _pad_pow2(len(reads))
@@ -266,7 +263,7 @@ def _freq_strings(
     scatter-add kernel, thresholding happens on the count table.
     """
     def compute(shard, reads, pad):
-        window = shard.range + _round_up(pad, 128)
+        window = shard.range + round_up_multiple(pad, 128)
         reads = [r for r in reads if r.mapping_quality >= min_mapping_qual]
         if not reads:
             return np.zeros((window, 5), np.int64)

@@ -1,0 +1,98 @@
+"""ctypes loader for the native ingest core, with transparent fallback.
+
+``load()`` returns the compiled library handle or ``None``; callers keep a
+pure-Python path so the framework runs on hosts without a toolchain (set
+``SPARK_EXAMPLES_TPU_NO_NATIVE=1`` to force the fallback — used by tests to
+cover both paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+__all__ = ["load", "native_available"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "genomics_native.cpp")
+_SO = os.path.join(_HERE, "_genomics_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++",
+        "-O3",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        _SRC,
+        "-o",
+        _SO,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if os.environ.get("SPARK_EXAMPLES_TPU_NO_NATIVE") == "1":
+        return None
+    if _tried:  # lock-free fast path once resolved (hot-loop callers)
+        return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            stale = not os.path.exists(_SO) or (
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            )
+        except OSError:
+            # Source missing (e.g. a deployed tree shipping only the .so):
+            # treat the existing library as current.
+            stale = not os.path.exists(_SO)
+        if stale and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.pack_calls.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+        ]
+        lib.murmur3_x64_128.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_uint64,
+            ctypes.c_void_p,
+        ]
+        lib.murmur3_x64_128_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_uint64,
+            ctypes.c_void_p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load() is not None

@@ -1,0 +1,117 @@
+// Native ingest core: the host-side hot loops of the data plane.
+//
+// The reference has no native code (pure JVM — SURVEY.md §2.9); the hot
+// host loops there are JIT-compiled Scala. In this framework the host side
+// is Python, so the two ingest-critical inner loops live here instead:
+//
+//   * pack_calls       — densify per-variant sample-index lists into the
+//                        0/1 int8 genotype block consumed by the MXU path
+//                        (the arrays/blocks.py fallback is a Python loop);
+//   * murmur3 batch    — the cross-dataset variant identity hash
+//                        (VariantsPca.scala:62-78 semantics), canonical
+//                        MurmurHash3 x64-128, byte-identical to the pure
+//                        Python implementation in genomics/hashing.py.
+//
+// Built by native/build.py with g++ -O3 -shared -fPIC; loaded via ctypes.
+// Everything is extern "C" with flat POD buffers — no pybind11 dependency.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// out must be a zeroed (n_samples, stride) row-major int8 buffer with
+// stride >= n_variants (the block may be column-padded).
+// indices[offsets[v] .. offsets[v+1]) are the carrying sample rows of
+// variant column v.
+void pack_calls(const int64_t* indices, const int64_t* offsets,
+                int64_t n_variants, int64_t n_samples, int64_t stride,
+                int8_t* out) {
+  for (int64_t v = 0; v < n_variants; ++v) {
+    for (int64_t k = offsets[v]; k < offsets[v + 1]; ++k) {
+      const int64_t s = indices[k];
+      if (s >= 0 && s < n_samples) {
+        out[s * stride + v] = 1;
+      }
+    }
+  }
+}
+
+static inline uint64_t rotl64(uint64_t x, int8_t r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t fmix64(uint64_t k) {
+  k ^= k >> 33;
+  k *= 0xff51afd7ed558ccdULL;
+  k ^= k >> 33;
+  k *= 0xc4ceb9fe1a85ec53ULL;
+  k ^= k >> 33;
+  return k;
+}
+
+static inline uint64_t load64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86-64 / aarch64)
+}
+
+void murmur3_x64_128(const uint8_t* data, int64_t len, uint64_t seed,
+                     uint8_t* out16) {
+  const int64_t nblocks = len / 16;
+  uint64_t h1 = seed, h2 = seed;
+  const uint64_t c1 = 0x87c37b91114253d5ULL;
+  const uint64_t c2 = 0x4cf5ad432745937fULL;
+
+  for (int64_t i = 0; i < nblocks; ++i) {
+    uint64_t k1 = load64(data + i * 16);
+    uint64_t k2 = load64(data + i * 16 + 8);
+
+    k1 *= c1; k1 = rotl64(k1, 31); k1 *= c2; h1 ^= k1;
+    h1 = rotl64(h1, 27); h1 += h2; h1 = h1 * 5 + 0x52dce729;
+
+    k2 *= c2; k2 = rotl64(k2, 33); k2 *= c1; h2 ^= k2;
+    h2 = rotl64(h2, 31); h2 += h1; h2 = h2 * 5 + 0x38495ab5;
+  }
+
+  const uint8_t* tail = data + nblocks * 16;
+  const int64_t taillen = len & 15;
+  uint64_t k1 = 0, k2 = 0;
+  if (taillen > 8) {
+    for (int64_t i = taillen - 1; i >= 8; --i) {
+      k2 = (k2 << 8) | tail[i];
+    }
+    k2 *= c2; k2 = rotl64(k2, 33); k2 *= c1; h2 ^= k2;
+  }
+  if (taillen > 0) {
+    const int64_t n1 = taillen < 8 ? taillen : 8;
+    for (int64_t i = n1 - 1; i >= 0; --i) {
+      k1 = (k1 << 8) | tail[i];
+    }
+    k1 *= c1; k1 = rotl64(k1, 31); k1 *= c2; h1 ^= k1;
+  }
+
+  h1 ^= static_cast<uint64_t>(len);
+  h2 ^= static_cast<uint64_t>(len);
+  h1 += h2;
+  h2 += h1;
+  h1 = fmix64(h1);
+  h2 = fmix64(h2);
+  h1 += h2;
+  h2 += h1;
+
+  std::memcpy(out16, &h1, 8);
+  std::memcpy(out16 + 8, &h2, 8);
+}
+
+// Hash n concatenated byte strings; string i spans
+// data[offsets[i] .. offsets[i+1]). out is n * 16 bytes.
+void murmur3_x64_128_batch(const uint8_t* data, const int64_t* offsets,
+                           int64_t n, uint64_t seed, uint8_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    murmur3_x64_128(data + offsets[i], offsets[i + 1] - offsets[i], seed,
+                    out + i * 16);
+  }
+}
+
+}  // extern "C"

@@ -70,6 +70,7 @@ def gramian_blockwise(
     accum_dtype=jnp.float32,
     compute_dtype=jnp.float32,
     device=None,
+    use_pallas=None,
 ):
     """Stream variant blocks through ``G += X_blk @ X_blk.T`` on device.
 
@@ -88,10 +89,56 @@ def gramian_blockwise(
     Returns:
       ``(N, N)`` device Gramian.
     """
+    from spark_examples_tpu.arrays.feed import device_prefetch
+
+    default_dtypes = (
+        accum_dtype == jnp.float32 and compute_dtype == jnp.float32
+    )
+    if use_pallas is None:
+        from spark_examples_tpu.ops.pallas_gramian import pallas_enabled
+
+        use_pallas = pallas_enabled() and jax.default_backend() == "tpu"
+    # The Pallas kernel accumulates in float32 only; honor explicit dtype
+    # requests by staying on the einsum path rather than silently
+    # downgrading.
+    if use_pallas and default_dtypes:
+        return _gramian_blockwise_pallas(blocks, n_samples, device)
+
     g = jnp.zeros((n_samples, n_samples), dtype=accum_dtype)
     if device is not None:
         g = jax.device_put(g, device)
-    for block in blocks:
-        xb = jax.device_put(np.asarray(block), device)
+    for xb in device_prefetch(blocks, device=device):
         g = gramian_accumulate(g, xb, compute_dtype=compute_dtype)
     return g
+
+
+def _gramian_blockwise_pallas(blocks, n_samples, device=None):
+    """Pallas-kernel accumulation path (opt-in; see ops/pallas_gramian.py).
+
+    Pads the sample axis to the kernel's tile multiple (zero rows are inert)
+    and each block's variant axis likewise; trims before returning.
+    """
+    from spark_examples_tpu.arrays.blocks import round_up_multiple
+    from spark_examples_tpu.arrays.feed import device_prefetch
+    from spark_examples_tpu.ops.pallas_gramian import (
+        BLOCK_N,
+        BLOCK_V,
+        gramian_accumulate_pallas,
+    )
+
+    n_pad = round_up_multiple(n_samples, BLOCK_N)
+
+    def padded():
+        for block in blocks:
+            xb = np.asarray(block)
+            v_pad = round_up_multiple(xb.shape[1], BLOCK_V)
+            yield np.pad(
+                xb, ((0, n_pad - n_samples), (0, v_pad - xb.shape[1]))
+            )
+
+    g = jnp.zeros((n_pad, n_pad), dtype=jnp.float32)
+    if device is not None:
+        g = jax.device_put(g, device)
+    for xb in device_prefetch(padded(), device=device):
+        g = gramian_accumulate_pallas(g, xb)
+    return g[:n_samples, :n_samples]

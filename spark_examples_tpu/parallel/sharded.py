@@ -91,8 +91,10 @@ def sharded_gramian_blockwise(
     # from the cohort's callset count, which is arbitrary, and device_put
     # requires the sharded dimension to divide evenly. Zero rows are inert
     # in X @ X.T (zero rows/cols of G), trimmed before returning.
+    from spark_examples_tpu.arrays.blocks import round_up_multiple
+
     divisor = mesh.shape[d_axis] * (mesh.shape[m_axis] if m_axis else 1)
-    n_padded = -(-n_samples // divisor) * divisor
+    n_padded = round_up_multiple(n_samples, divisor)
 
     @partial(jax.jit, donate_argnums=(0,), out_shardings=g_sharding)
     def _accum(g, xb):
@@ -101,14 +103,20 @@ def sharded_gramian_blockwise(
             "nv,mv->nm", xf, xf, preferred_element_type=g.dtype
         )
 
+    from spark_examples_tpu.arrays.feed import device_prefetch
+
+    def padded_blocks():
+        for block in blocks:
+            xb = np.asarray(block)
+            if n_padded != n_samples:
+                xb = np.pad(xb, ((0, n_padded - n_samples), (0, 0)))
+            yield xb
+
     g = jax.device_put(
         jnp.zeros((n_padded, n_padded), dtype=accum_dtype), g_sharding
     )
-    for block in blocks:
-        xb = np.asarray(block)
-        if n_padded != n_samples:
-            xb = np.pad(xb, ((0, n_padded - n_samples), (0, 0)))
-        g = _accum(g, jax.device_put(xb, x_sharding))
+    for xb in device_prefetch(padded_blocks(), sharding=x_sharding):
+        g = _accum(g, xb)
     return g[:n_samples, :n_samples]
 
 

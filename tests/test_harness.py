@@ -6,3 +6,54 @@ import jax
 def test_virtual_device_count():
     assert jax.default_backend() == "cpu"
     assert jax.device_count() == 8
+
+
+def test_device_prefetch_order_and_error():
+    import numpy as np
+    import pytest
+
+    from spark_examples_tpu.arrays.feed import device_prefetch
+
+    blocks = [np.full((4, 4), i, np.int8) for i in range(7)]
+    out = [int(np.asarray(b)[0, 0]) for b in device_prefetch(iter(blocks))]
+    assert out == list(range(7))
+
+    def failing():
+        yield np.zeros((2, 2), np.int8)
+        raise IOError("ingest died")
+
+    it = device_prefetch(failing())
+    next(it)
+    with pytest.raises(IOError, match="ingest died"):
+        list(it)
+
+
+def test_device_prefetch_abandoned_consumer_releases_producer():
+    import threading
+    import time
+
+    import numpy as np
+
+    from spark_examples_tpu.arrays.feed import device_prefetch
+
+    started = threading.Event()
+    n_produced = []
+
+    def blocks():
+        for i in range(100):
+            started.set()
+            n_produced.append(i)
+            yield np.zeros((64, 64), np.int8)
+
+    it = device_prefetch(blocks(), depth=2)
+    next(it)
+    started.wait(5)
+    it.close()  # consumer abandons mid-stream
+    deadline = time.time() + 5
+    while time.time() < deadline and threading.active_count() > 20:
+        time.sleep(0.05)
+    time.sleep(0.3)
+    produced_after_close = len(n_produced)
+    time.sleep(0.5)
+    # Producer must have stopped: no further blocks drawn from the source.
+    assert len(n_produced) <= produced_after_close + 1

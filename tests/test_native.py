@@ -1,0 +1,71 @@
+"""Native core: build, byte-parity with Python fallbacks, speed sanity."""
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.native import load, native_available
+
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+
+class TestMurmurParity:
+    def test_byte_identical_to_python(self):
+        from spark_examples_tpu.genomics.hashing import (
+            _murmur3_py,
+            murmur3_x64_128,
+        )
+
+        rng = np.random.default_rng(0)
+        for n in list(range(0, 40)) + [1000, 4096]:
+            data = bytes(rng.integers(0, 256, size=n, dtype=np.uint8))
+            assert murmur3_x64_128(data) == _murmur3_py(data), n
+
+    def test_batch_matches_single(self):
+        import ctypes
+
+        lib = load()
+        strings = [b"", b"a", b"hello world", b"x" * 33]
+        blob = b"".join(strings)
+        offsets = np.zeros(len(strings) + 1, np.int64)
+        for i, s in enumerate(strings):
+            offsets[i + 1] = offsets[i] + len(s)
+        out = ctypes.create_string_buffer(16 * len(strings))
+        lib.murmur3_x64_128_batch(
+            blob, offsets.ctypes.data, len(strings), 0, out
+        )
+        from spark_examples_tpu.genomics.hashing import _murmur3_py
+
+        for i, s in enumerate(strings):
+            assert out.raw[i * 16 : (i + 1) * 16] == _murmur3_py(s)
+
+
+class TestPackCalls:
+    def test_matches_python_fallback(self, monkeypatch):
+        from spark_examples_tpu.arrays.blocks import densify_calls
+
+        rng = np.random.default_rng(1)
+        calls = [
+            list(rng.choice(50, size=rng.integers(0, 50), replace=False))
+            for _ in range(200)
+        ]
+        native = densify_calls(calls, 50, 256)
+
+        monkeypatch.setenv("SPARK_EXAMPLES_TPU_NO_NATIVE", "1")
+        fallback = densify_calls(calls, 50, 256)
+        np.testing.assert_array_equal(native, fallback)
+
+    def test_out_of_range_index_raises_both_paths(self, monkeypatch):
+        from spark_examples_tpu.arrays.blocks import densify_calls
+
+        with pytest.raises(ValueError, match="out of range"):
+            densify_calls([[0, 99], [1]], 3, 2)
+        with pytest.raises(ValueError, match="out of range"):
+            densify_calls([[-1]], 3, 1)
+        monkeypatch.setenv("SPARK_EXAMPLES_TPU_NO_NATIVE", "1")
+        with pytest.raises(ValueError, match="out of range"):
+            densify_calls([[0, 99], [1]], 3, 2)
+        with pytest.raises(ValueError, match="out of range"):
+            densify_calls([[-1]], 3, 1)
