@@ -1,0 +1,59 @@
+"""External-driver example: delegate the dense math over the bridge.
+
+The role the reference's PySpark twin plays (``variants_pca.py``: drive the
+ingest elsewhere, hand the per-variant call data to the math backend). Any
+process — a Spark/Scala driver, a workflow engine — speaks the same
+newline-JSON protocol; this script is the minimal client: it generates a
+cohort locally (standing in for the external ingest), streams the
+``RDD[Seq[Int]]``-shaped call lists to a running ``pca-bridge`` server, and
+prints the returned principal coordinates.
+
+Usage:
+    python -m spark_examples_tpu.cli.main pca-bridge --port 18717 &
+    python examples/external_driver_pca.py --port 18717
+"""
+
+import argparse
+
+from spark_examples_tpu.bridge import PcaBridgeClient
+from spark_examples_tpu.genomics.callsets import CallsetIndex
+from spark_examples_tpu.genomics.datasets import calls_stream
+from spark_examples_tpu.genomics.fixtures import (
+    DEFAULT_VARIANT_SET_ID,
+    synthetic_cohort,
+)
+from spark_examples_tpu.genomics.shards import shards_for_references
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=18717)
+    p.add_argument("--samples", type=int, default=50)
+    p.add_argument("--variants", type=int, default=500)
+    p.add_argument("--num-pc", type=int, default=2)
+    args = p.parse_args()
+
+    # "External" ingest: any system that can produce per-variant lists of
+    # carrying-sample indices.
+    source = synthetic_cohort(args.samples, args.variants)
+    index = CallsetIndex.from_source(source, [DEFAULT_VARIANT_SET_ID])
+    shards = shards_for_references("17:41196311:41277499")
+    variants = (
+        v
+        for s in shards
+        for v in source.stream_variants(DEFAULT_VARIANT_SET_ID, s)
+    )
+    calls = calls_stream([variants], index.indexes)
+
+    client = PcaBridgeClient(port=args.port)
+    coords, eigvals = client.compute(calls, index.size, args.num_pc)
+    client.close()
+
+    names = index.name_of_index()
+    for name, row in sorted(zip(names, coords.tolist())):
+        print(name + "\t" + "\t".join(f"{c:.6f}" for c in row))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

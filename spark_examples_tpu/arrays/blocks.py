@@ -44,6 +44,10 @@ def densify_calls(
     (:mod:`spark_examples_tpu.native`), with this numpy loop as fallback.
     """
     width = width if width is not None else len(calls)
+    if width < len(calls):
+        raise ValueError(
+            f"width {width} < number of variants {len(calls)}"
+        )
     from spark_examples_tpu.native import load
 
     lib = load()

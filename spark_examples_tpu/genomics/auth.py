@@ -1,0 +1,104 @@
+"""Authentication layer — Client.scala parity for network sources.
+
+The reference resolves credentials in two ways (``Client.scala:29-46``):
+
+1. ``--client-secrets <file>``: builds a user credential — after printing a
+   warning that the credential becomes visible to every worker and
+   requiring an interactive ``Y/n`` confirmation on stdin
+   (``Client.scala:32-41``);
+2. otherwise Application Default Credentials.
+
+Here the same surface exists for whatever Genomics-compatible service a
+network source targets. Per SURVEY.md §2.1's note, the interactive prompt
+must never block headless multi-host startup: confirmation is only
+requested when the process is the coordinator AND stdin is a TTY;
+non-interactive contexts fail closed with an instructive error instead of
+hanging a pod.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from dataclasses import dataclass
+from typing import Optional
+
+__all__ = ["Credentials", "get_access_token", "AuthError"]
+
+# ADC-style environment variable (the "Application Default" path).
+ADC_ENV = "GENOMICS_APPLICATION_CREDENTIALS"
+
+
+class AuthError(RuntimeError):
+    pass
+
+
+@dataclass(frozen=True)
+class Credentials:
+    """An offline credential shippable to every ingest process — the
+    ``OfflineAuth`` analog (serializable, no interactive state)."""
+
+    token: str
+    source: str  # "client-secrets" | "application-default" | "anonymous"
+
+
+_WARNING = (
+    "The Genomics API will be accessed using your user credentials; the "
+    "credential will be visible to every process of this run. Only "
+    "continue if that is acceptable. Continue? [Y/n] "
+)
+
+
+def get_access_token(
+    client_secrets_path: Optional[str] = None,
+    interactive: Optional[bool] = None,
+    _input=input,
+) -> Credentials:
+    """Resolve credentials — Authentication.getAccessToken semantics.
+
+    Args:
+      client_secrets_path: path to a JSON file with a ``token`` (or
+        ``client_id``/``client_secret``) entry; triggers the visibility
+        warning + confirmation.
+      interactive: force/deny the confirmation prompt; default = stdin is
+        a TTY *and* this process is the coordinator (process 0).
+    """
+    if client_secrets_path:
+        if interactive is None:
+            try:
+                import jax
+
+                is_coord = jax.process_index() == 0
+            except Exception:  # jax uninitialized — single process
+                is_coord = True
+            interactive = sys.stdin.isatty() and is_coord
+        if interactive:
+            answer = _input(_WARNING).strip().lower()
+            if answer not in ("", "y", "yes"):
+                raise AuthError("user declined client-secrets credential")
+        else:
+            raise AuthError(
+                "client-secrets credentials need interactive confirmation "
+                "(Client.scala:32-41 semantics); headless runs must use "
+                f"application-default credentials (set {ADC_ENV})"
+            )
+        with open(client_secrets_path) as f:
+            secrets = json.load(f)
+        token = secrets.get("token") or secrets.get("client_id")
+        if not token:
+            raise AuthError(
+                f"{client_secrets_path} has neither 'token' nor 'client_id'"
+            )
+        return Credentials(token=token, source="client-secrets")
+
+    adc = os.environ.get(ADC_ENV)
+    if adc:
+        if os.path.exists(adc):
+            with open(adc) as f:
+                token = json.load(f).get("token", "")
+        else:
+            token = adc  # the variable may carry the token directly
+        if token:
+            return Credentials(token=token, source="application-default")
+    return Credentials(token="", source="anonymous")

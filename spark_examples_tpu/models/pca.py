@@ -123,6 +123,29 @@ class VariantsPcaDriver:
         )
         return self._blocks_to_gramian(blocks)
 
+    def get_similarity_matrix_stream(self, calls: Iterable[List[int]]):
+        """Sparse pairwise alternative — getSimilarityMatrixStream parity.
+
+        The reference ships an uncalled alternate that trades the dense
+        per-task N×N matrix for O(Σk²) shuffled pair contributions
+        (``VariantsPca.scala:248-279``). The TPU analog: host-side sparse
+        scatter-accumulation, profitable only when the cohort is so sparse
+        that Σk² ≪ N·V (the MXU path is otherwise strictly faster). Kept
+        for API/algorithm parity; ``run()`` uses the blockwise MXU path,
+        exactly as the reference's ``main`` uses the dense one.
+        """
+        from spark_examples_tpu.arrays.blocks import _check_indices
+
+        n = self.index.size
+        g = np.zeros((n, n), dtype=np.int64)
+        for sample_indices in calls:
+            idx = np.asarray(sample_indices, dtype=np.int64)
+            _check_indices(idx, n)  # same loud failure as the dense path
+            g[np.ix_(idx, idx)] += 1
+        import jax.numpy as jnp
+
+        return jnp.asarray(g.astype(np.float32))
+
     def get_similarity_matrix_checkpointed(self):
         """Shard-group ingest with incremental (G, cursor) snapshots.
 

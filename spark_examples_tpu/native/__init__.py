@@ -26,6 +26,10 @@ _tried = False
 
 
 def _build() -> bool:
+    # Compile to a process-unique temp path and rename atomically:
+    # concurrent builders (multi-host launch, pytest-xdist) must never
+    # leave a half-written .so where another process dlopens it.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = [
         "g++",
         "-O3",
@@ -34,14 +38,17 @@ def _build() -> bool:
         "-std=c++17",
         _SRC,
         "-o",
-        _SO,
+        tmp,
     ]
     try:
-        subprocess.run(
-            cmd, check=True, capture_output=True, timeout=120
-        )
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return True
     except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

@@ -57,7 +57,11 @@ def _kernel(xi_ref, xj_ref, g_in_ref, g_out_ref):
     donate_argnums=(0,),
 )
 def gramian_accumulate_pallas(
-    g, x_block, block_n: int = 256, block_v: int = 512, interpret: bool = False
+    g,
+    x_block,
+    block_n: int = BLOCK_N,
+    block_v: int = BLOCK_V,
+    interpret: bool = False,
 ):
     """One accumulation step ``G += X_blk @ X_blk.T`` as a Pallas kernel.
 

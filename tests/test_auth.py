@@ -1,0 +1,76 @@
+"""Auth layer tests — Client.scala:29-46 semantics."""
+
+import json
+
+import pytest
+
+from spark_examples_tpu.genomics.auth import (
+    ADC_ENV,
+    AuthError,
+    Credentials,
+    get_access_token,
+)
+
+
+class TestClientSecrets:
+    def test_interactive_confirm_accepts(self, tmp_path):
+        f = tmp_path / "secrets.json"
+        f.write_text(json.dumps({"token": "tok123"}))
+        creds = get_access_token(
+            str(f), interactive=True, _input=lambda prompt: "y"
+        )
+        assert creds == Credentials("tok123", "client-secrets")
+
+    def test_interactive_default_yes(self, tmp_path):
+        f = tmp_path / "secrets.json"
+        f.write_text(json.dumps({"token": "t"}))
+        creds = get_access_token(
+            str(f), interactive=True, _input=lambda prompt: ""
+        )
+        assert creds.source == "client-secrets"
+
+    def test_interactive_decline_raises(self, tmp_path):
+        f = tmp_path / "secrets.json"
+        f.write_text(json.dumps({"token": "t"}))
+        with pytest.raises(AuthError, match="declined"):
+            get_access_token(
+                str(f), interactive=True, _input=lambda prompt: "n"
+            )
+
+    def test_headless_fails_closed_not_hang(self, tmp_path):
+        """Multi-host pods must never block on stdin (SURVEY §2.1)."""
+        f = tmp_path / "secrets.json"
+        f.write_text(json.dumps({"token": "t"}))
+        with pytest.raises(AuthError, match="interactive confirmation"):
+            get_access_token(str(f), interactive=False)
+
+
+class TestApplicationDefault:
+    def test_adc_file(self, tmp_path, monkeypatch):
+        f = tmp_path / "adc.json"
+        f.write_text(json.dumps({"token": "adc-tok"}))
+        monkeypatch.setenv(ADC_ENV, str(f))
+        creds = get_access_token()
+        assert creds == Credentials("adc-tok", "application-default")
+
+    def test_anonymous_fallback(self, monkeypatch):
+        monkeypatch.delenv(ADC_ENV, raising=False)
+        assert get_access_token().source == "anonymous"
+
+
+def test_stream_similarity_matches_dense():
+    import numpy as np
+
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    conf = PcaConfig(variant_set_ids=[DEFAULT_VARIANT_SET_ID], block_variants=32)
+    driver = VariantsPcaDriver(conf, synthetic_cohort(12, 90))
+    calls = list(driver.get_calls(driver.get_data()))
+    dense = np.asarray(driver.get_similarity_matrix(iter(calls)))
+    stream = np.asarray(driver.get_similarity_matrix_stream(iter(calls)))
+    np.testing.assert_array_equal(dense, stream)
