@@ -53,6 +53,15 @@ def make_blocks(seed=0):
 
 def tpu_time(blocks):
     import jax
+    import jax.numpy as jnp
+
+    # BENCH_INT8=1: int8×int8→int32 einsum — on TPU the int matmul path
+    # can outrun f32 for 0/1 operands; numerically exact either way
+    # (tests/test_harness.py::test_int8_int32_gramian_exact).
+    int8 = os.environ.get("BENCH_INT8") == "1"
+    dt = dict(
+        compute_dtype=jnp.int8, accum_dtype=jnp.int32
+    ) if int8 else {}
 
     # Persistent compilation cache: the N≈2500 eigh compile is minutes the
     # first time; cached thereafter.
@@ -63,14 +72,14 @@ def tpu_time(blocks):
     from spark_examples_tpu.ops import gramian_blockwise, pcoa
 
     # Warm-up: compile both programs on a throwaway pass.
-    _log(f"bench: compiling (N={N_SAMPLES}, V={N_VARIANTS}) ...")
-    g = gramian_blockwise(blocks[:1], N_SAMPLES)
-    pcoa(g, NUM_PC)[0].block_until_ready()
+    _log(f"bench: compiling (N={N_SAMPLES}, V={N_VARIANTS}, int8={int8}) ...")
+    g = gramian_blockwise(blocks[:1], N_SAMPLES, **dt)
+    pcoa(g.astype(jnp.float32), NUM_PC)[0].block_until_ready()
     _log("bench: compiled; timing steady-state")
 
     t0 = time.perf_counter()
-    g = gramian_blockwise(blocks, N_SAMPLES)
-    coords, _ = pcoa(g, NUM_PC)
+    g = gramian_blockwise(blocks, N_SAMPLES, **dt)
+    coords, _ = pcoa(g.astype(jnp.float32), NUM_PC)
     coords.block_until_ready()
     return time.perf_counter() - t0, np.asarray(coords)
 

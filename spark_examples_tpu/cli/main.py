@@ -37,13 +37,10 @@ def _add_fixture_flags(p: argparse.ArgumentParser) -> None:
 
 
 def _resolve_source(args, references: str):
-    if getattr(args, "client_secrets", None):
-        # Authentication.getAccessToken semantics incl. the interactive
-        # visibility warning (Client.scala:29-46); fixture/JSONL sources
-        # don't consume the credential, network sources do.
-        from spark_examples_tpu.genomics.auth import get_access_token
-
-        get_access_token(args.client_secrets)
+    # Offline sources (fixture/JSONL) never consume credentials, so
+    # --client-secrets stays inert for them; a network VariantSource
+    # resolves its credential via genomics.auth.get_access_token (the
+    # Authentication.getAccessToken analog, Client.scala:29-46).
     if args.input_path:
         return JsonlSource(args.input_path)
     if args.fixture_samples:

@@ -62,43 +62,50 @@ def get_access_token(
         ``client_id``/``client_secret``) entry; triggers the visibility
         warning + confirmation.
       interactive: force/deny the confirmation prompt; default = stdin is
-        a TTY *and* this process is the coordinator (process 0).
+        a TTY. (Deliberately never queries jax: multi-host worker
+        processes have no TTY, so they fail closed; touching
+        ``jax.process_index()`` here would initialize the backend before
+        ``jax.distributed.initialize`` and break multi-host startup.)
     """
     if client_secrets_path:
-        if interactive is None:
-            try:
-                import jax
-
-                is_coord = jax.process_index() == 0
-            except Exception:  # jax uninitialized — single process
-                is_coord = True
-            interactive = sys.stdin.isatty() and is_coord
-        if interactive:
-            answer = _input(_WARNING).strip().lower()
-            if answer not in ("", "y", "yes"):
-                raise AuthError("user declined client-secrets credential")
-        else:
+        # Validate the file before prompting — a bad path/JSON is an
+        # AuthError, not a post-confirmation traceback.
+        try:
+            with open(client_secrets_path) as f:
+                secrets = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
             raise AuthError(
-                "client-secrets credentials need interactive confirmation "
-                "(Client.scala:32-41 semantics); headless runs must use "
-                f"application-default credentials (set {ADC_ENV})"
-            )
-        with open(client_secrets_path) as f:
-            secrets = json.load(f)
+                f"cannot read client secrets {client_secrets_path}: {e}"
+            ) from e
         token = secrets.get("token") or secrets.get("client_id")
         if not token:
             raise AuthError(
                 f"{client_secrets_path} has neither 'token' nor 'client_id'"
             )
+        if interactive is None:
+            interactive = sys.stdin.isatty()
+        if not interactive:
+            raise AuthError(
+                "client-secrets credentials need interactive confirmation "
+                "(Client.scala:32-41 semantics); headless runs must use "
+                f"application-default credentials (set {ADC_ENV})"
+            )
+        answer = _input(_WARNING).strip().lower()
+        if answer not in ("", "y", "yes"):
+            raise AuthError("user declined client-secrets credential")
         return Credentials(token=token, source="client-secrets")
 
     adc = os.environ.get(ADC_ENV)
     if adc:
-        if os.path.exists(adc):
+        # The variable must name a readable token-bearing JSON file; an
+        # explicitly configured credential silently degrading to
+        # anonymous would be worse than failing.
+        try:
             with open(adc) as f:
                 token = json.load(f).get("token", "")
-        else:
-            token = adc  # the variable may carry the token directly
-        if token:
-            return Credentials(token=token, source="application-default")
+        except (OSError, json.JSONDecodeError) as e:
+            raise AuthError(f"cannot read {ADC_ENV}={adc}: {e}") from e
+        if not token:
+            raise AuthError(f"{ADC_ENV}={adc} has no 'token' entry")
+        return Credentials(token=token, source="application-default")
     return Credentials(token="", source="anonymous")

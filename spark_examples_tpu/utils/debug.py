@@ -1,0 +1,53 @@
+"""Numerics/race debugging aids.
+
+The reference's safety story is immutability plus Spark's driver-merged
+accumulators (SURVEY.md §5 — no sanitizers, no race detection). The moving
+parts here that can race are explicit and few: the prefetch producer
+thread (`arrays/feed.py`, bounded queue + stop event), the bridge server
+threads (per-connection state only), and the IoStats counters (lock-held
+increments). This module adds the numerics half: a toggle for JAX's
+NaN/Inf tracers and a checked-accumulation helper used by tests to prove
+the Gramian stays within exact-f32 range.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["debug_numerics", "assert_exact_f32_range"]
+
+
+@contextlib.contextmanager
+def debug_numerics(enable: bool = True) -> Iterator[None]:
+    """Enable jax_debug_nans/jax_debug_infs for the enclosed region."""
+    if not enable:
+        yield
+        return
+    prev_nans = jax.config.jax_debug_nans
+    prev_infs = jax.config.jax_debug_infs
+    jax.config.update("jax_debug_nans", True)
+    jax.config.update("jax_debug_infs", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev_nans)
+        jax.config.update("jax_debug_infs", prev_infs)
+
+
+def assert_exact_f32_range(g) -> None:
+    """Fail if any Gramian entry exceeds 2^24 — the bound below which f32
+    accumulation of 0/1 products is exact (ops/gramian.py docstring).
+
+    Beyond it, switch to ``accum_dtype=jnp.int32`` (exact to 2^31) — see
+    :func:`spark_examples_tpu.ops.gramian`.
+    """
+    mx = float(jnp.max(jnp.asarray(g)))
+    if mx >= float(1 << 24):
+        raise AssertionError(
+            f"Gramian entry {mx} ≥ 2^24: f32 accumulation no longer exact; "
+            "use accum_dtype=jnp.int32"
+        )

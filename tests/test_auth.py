@@ -57,20 +57,26 @@ class TestApplicationDefault:
         monkeypatch.delenv(ADC_ENV, raising=False)
         assert get_access_token().source == "anonymous"
 
+    def test_adc_without_token_fails_loud(self, tmp_path, monkeypatch):
+        f = tmp_path / "sa.json"
+        f.write_text(json.dumps({"private_key": "x", "client_email": "y"}))
+        monkeypatch.setenv(ADC_ENV, str(f))
+        with pytest.raises(AuthError, match="no 'token'"):
+            get_access_token()
 
-def test_stream_similarity_matches_dense():
-    import numpy as np
+    def test_adc_bad_path_fails_loud(self, monkeypatch):
+        monkeypatch.setenv(ADC_ENV, "/no/such/file.json")
+        with pytest.raises(AuthError, match="cannot read"):
+            get_access_token()
 
-    from spark_examples_tpu.genomics.fixtures import (
-        DEFAULT_VARIANT_SET_ID,
-        synthetic_cohort,
-    )
-    from spark_examples_tpu.models.pca import VariantsPcaDriver
-    from spark_examples_tpu.utils.config import PcaConfig
 
-    conf = PcaConfig(variant_set_ids=[DEFAULT_VARIANT_SET_ID], block_variants=32)
-    driver = VariantsPcaDriver(conf, synthetic_cohort(12, 90))
-    calls = list(driver.get_calls(driver.get_data()))
-    dense = np.asarray(driver.get_similarity_matrix(iter(calls)))
-    stream = np.asarray(driver.get_similarity_matrix_stream(iter(calls)))
-    np.testing.assert_array_equal(dense, stream)
+class TestSecretsValidation:
+    def test_bad_secrets_path_is_autherror_before_prompt(self):
+        prompts = []
+        with pytest.raises(AuthError, match="cannot read"):
+            get_access_token(
+                "/no/such/secrets.json",
+                interactive=True,
+                _input=lambda p: prompts.append(p) or "y",
+            )
+        assert prompts == []  # never prompted for an unreadable file

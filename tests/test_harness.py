@@ -57,3 +57,37 @@ def test_device_prefetch_abandoned_consumer_releases_producer():
     time.sleep(0.5)
     # Producer must have stopped: no further blocks drawn from the source.
     assert len(n_produced) <= produced_after_close + 1
+
+
+def test_int8_int32_gramian_exact():
+    """int8 x int8 -> int32 einsum (the MXU int-matmul path) is exact and
+    matches the f32 path."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from spark_examples_tpu.ops import gramian
+
+    rng = np.random.default_rng(0)
+    x = (rng.random((64, 512)) < 0.4).astype(np.int8)
+    g_int = gramian(x, compute_dtype=jnp.int8, accum_dtype=jnp.int32)
+    g_f32 = gramian(x)
+    assert g_int.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(g_int), np.asarray(g_f32))
+
+
+def test_debug_numerics_and_range_guard():
+    import numpy as np
+    import jax.numpy as jnp
+    import pytest
+
+    from spark_examples_tpu.utils.debug import (
+        assert_exact_f32_range,
+        debug_numerics,
+    )
+
+    assert_exact_f32_range(jnp.ones((3, 3)))
+    with pytest.raises(AssertionError, match="2\\^24"):
+        assert_exact_f32_range(jnp.full((2, 2), float(1 << 24)))
+    with debug_numerics():
+        with pytest.raises(FloatingPointError):
+            _ = jnp.log(jnp.zeros(2)) * 0  # -inf triggers debug_infs

@@ -165,3 +165,21 @@ class TestCli:
         assert rc == 0
         out = capsys.readouterr().out
         assert "Matrix size: 9" in out
+
+
+def test_stream_similarity_matches_dense():
+    import numpy as np
+
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    conf = PcaConfig(variant_set_ids=[DEFAULT_VARIANT_SET_ID], block_variants=32)
+    driver = VariantsPcaDriver(conf, synthetic_cohort(12, 90))
+    calls = list(driver.get_calls(driver.get_data()))
+    dense = np.asarray(driver.get_similarity_matrix(iter(calls)))
+    stream = np.asarray(driver.get_similarity_matrix_stream(iter(calls)))
+    np.testing.assert_array_equal(dense, stream)
