@@ -112,7 +112,14 @@ def cpu_reference_time(blocks):
 
 def main():
     blocks = make_blocks()
-    t_tpu, coords_tpu = tpu_time(blocks)
+    # The axon remote-compile tunnel occasionally drops a request
+    # (transient INTERNAL "response body closed"); one retry covers it.
+    try:
+        t_tpu, coords_tpu = tpu_time(blocks)
+    except Exception as e:  # noqa: BLE001 — retry once, then fail for real
+        _log(f"bench: first attempt failed ({type(e).__name__}: {e}); retrying")
+        time.sleep(10)
+        t_tpu, coords_tpu = tpu_time(blocks)
     t_cpu, _ = cpu_reference_time(blocks)
 
     value = N_SAMPLES * N_SAMPLES * N_VARIANTS / t_tpu

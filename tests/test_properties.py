@@ -1,0 +1,102 @@
+"""Property-based tests (hypothesis) for the semantic-critical transforms.
+
+SURVEY.md §4 calls for property/golden tests of every pure transform;
+these cover the invariants that example-based tests can miss.
+"""
+
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st
+
+from spark_examples_tpu.genomics.hashing import _murmur3_py, murmur3_x64_128
+from spark_examples_tpu.genomics.shards import shards_for_references
+from spark_examples_tpu.genomics.types import normalize_contig
+from spark_examples_tpu.ops import double_center, gramian
+
+
+class TestMurmurProperties:
+    @given(st.binary(max_size=200))
+    @settings(max_examples=200, deadline=None)
+    def test_native_matches_python_reference(self, data):
+        from spark_examples_tpu.native import load
+
+        if load() is None:
+            pytest.skip("native library unavailable — parity not testable")
+        assert murmur3_x64_128(data) == _murmur3_py(data)
+
+    @given(st.binary(min_size=1, max_size=64), st.integers(0, 511))
+    @settings(max_examples=100, deadline=None)
+    def test_bit_flip_changes_digest(self, data, bit):
+        bit = bit % (len(data) * 8)
+        flipped = bytearray(data)
+        flipped[bit // 8] ^= 1 << (bit % 8)
+        assert murmur3_x64_128(data) != murmur3_x64_128(bytes(flipped))
+
+
+class TestContigProperties:
+    @given(st.text(alphabet="abcdefghijklmnopqrstuvwxyz", max_size=5),
+           st.integers(0, 99))
+    @settings(max_examples=100, deadline=None)
+    def test_lower_prefix_plus_digits_keeps_digits(self, prefix, num):
+        # Any [a-z]* prefix followed by digits normalizes to the digits —
+        # the full generality of the reference regex, not just "chr".
+        assert normalize_contig(f"{prefix}{num}") == str(num)
+
+    @given(st.text(min_size=1, max_size=10))
+    @settings(max_examples=200, deadline=None)
+    def test_never_crashes_and_drops_or_keeps(self, name):
+        out = normalize_contig(name)
+        if out is not None:
+            assert out == "" or out.isdigit()
+
+
+class TestShardProperties:
+    @given(
+        st.integers(0, 10_000_000),
+        st.integers(1, 5_000_000),
+        st.integers(1, 1000),
+    )
+    @settings(max_examples=100, deadline=None)
+    def test_windows_partition_the_range_exactly(self, start, length, n_shards):
+        end = start + length
+        bps = max(1, -(-length // n_shards))  # cap shard count at ~1000
+        shards = shards_for_references(f"7:{start}:{end}", bps)
+        assert shards[0].start == start and shards[-1].end == end
+        for a, b in zip(shards, shards[1:]):
+            assert a.end == b.start  # adjacent, no gaps/overlap
+        assert sum(s.range for s in shards) == length
+        # STRICT: every position belongs to exactly one shard, found by
+        # index arithmetic (no O(n_shards) scan).
+        for pos in {start, end - 1, start + length // 2}:
+            k = (pos - start) // bps
+            assert shards[k].start <= pos < shards[k].end
+            if k + 1 < len(shards):
+                assert not (shards[k + 1].start <= pos < shards[k + 1].end)
+
+
+class TestGramianProperties:
+    @given(st.integers(1, 12), st.integers(1, 40), st.integers(0, 2**31 - 1))
+    @settings(max_examples=15, deadline=None)  # each new shape recompiles
+    def test_gramian_symmetric_psd_diag_dominant(self, n, v, seed):
+        rng = np.random.default_rng(seed)
+        x = (rng.random((n, v)) < 0.4).astype(np.int8)
+        g = np.asarray(gramian(x))
+        assert np.array_equal(g, g.T)
+        # diagonal = per-sample variant counts; off-diag ≤ min(diag_i, diag_j)
+        d = np.diag(g)
+        assert (g <= np.minimum.outer(d, d) + 1e-6).all()
+        w = np.linalg.eigvalsh(g.astype(np.float64))
+        assert w.min() >= -1e-6  # PSD
+
+    @given(st.integers(2, 10), st.integers(0, 2**31 - 1))
+    @settings(max_examples=15, deadline=None)  # each new shape recompiles
+    def test_double_center_idempotent_and_zero_mean(self, n, seed):
+        rng = np.random.default_rng(seed)
+        g = rng.random((n, n))
+        g = g + g.T
+        c1 = np.asarray(double_center(g))
+        c2 = np.asarray(double_center(c1))
+        np.testing.assert_allclose(c1, c2, atol=1e-4)  # idempotent
+        np.testing.assert_allclose(c1.mean(0), 0, atol=1e-5)
