@@ -60,10 +60,14 @@ class VariantsPcaDriver:
 
         The analog of ``VariantsCommon.data`` (VariantsCommon.scala:52-66):
         nothing is fetched until the Gramian pass consumes the streams.
+        Under multi-host each process ingests a round-robin slice of the
+        manifest; partial Gramians merge in get_similarity_matrix.
         """
-        shards = self.conf.shards(
-            all_references=self.conf.all_references,
-            sex_filter=SexChromosomeFilter.EXCLUDE_XY,
+        shards = self._host_shards(
+            self.conf.shards(
+                all_references=self.conf.all_references,
+                sex_filter=SexChromosomeFilter.EXCLUDE_XY,
+            )
         )
 
         def stream(vsid: str) -> Iterator[Variant]:
@@ -71,6 +75,13 @@ class VariantsPcaDriver:
                 yield from self.source.stream_variants(vsid, shard)
 
         return [stream(vsid) for vsid in self.conf.variant_set_ids]
+
+    @staticmethod
+    def _host_shards(shards):
+        """Round-robin manifest slice for this process (DP across hosts)."""
+        if jax.process_count() > 1:
+            return shards[jax.process_index() :: jax.process_count()]
+        return shards
 
     # -- stage 2: filters ----------------------------------------------------
 
@@ -117,11 +128,23 @@ class VariantsPcaDriver:
         return g
 
     def get_similarity_matrix(self, calls: Iterable[List[int]]):
-        """Stream call blocks through the device accumulator → (N, N) G."""
+        """Stream call blocks through the device accumulator → (N, N) G.
+
+        Multi-host: this host's partial Gramian (over its manifest slice)
+        is summed across processes — one DCN all-reduce replaces the
+        reference's N²-entry shuffle (VariantsPca.scala:190).
+        """
         blocks = blocks_from_calls(
             calls, self.index.size, self.conf.block_variants
         )
-        return self._blocks_to_gramian(blocks)
+        g = self._blocks_to_gramian(blocks)
+        if jax.process_count() > 1:
+            from spark_examples_tpu.parallel.distributed import (
+                allreduce_gramian,
+            )
+
+            g = allreduce_gramian(g)
+        return g
 
     def get_similarity_matrix_stream(self, calls: Iterable[List[int]]):
         """Sparse pairwise alternative — getSimilarityMatrixStream parity.
@@ -144,7 +167,14 @@ class VariantsPcaDriver:
             g[np.ix_(idx, idx)] += 1
         import jax.numpy as jnp
 
-        return jnp.asarray(g.astype(np.float32))
+        out = jnp.asarray(g.astype(np.float32))
+        if jax.process_count() > 1:
+            from spark_examples_tpu.parallel.distributed import (
+                allreduce_gramian,
+            )
+
+            out = allreduce_gramian(out)
+        return out
 
     def get_similarity_matrix_checkpointed(self):
         """Shard-group ingest with incremental (G, cursor) snapshots.
@@ -164,6 +194,12 @@ class VariantsPcaDriver:
         assert len(self.conf.variant_set_ids) == 1, (
             "checkpointed ingest supports a single variantset"
         )
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "checkpointed ingest is single-host for now: hosts would "
+                "race on one snapshot file; use per-host checkpoint dirs "
+                "in a future revision"
+            )
         vsid = self.conf.variant_set_ids[0]
         shards = self.conf.shards(
             all_references=self.conf.all_references,
@@ -243,6 +279,10 @@ class VariantsPcaDriver:
     # -- stage 6: emission ---------------------------------------------------
 
     def emit_result(self, result: Sequence[Tuple[str, float, float]]) -> None:
+        from spark_examples_tpu.parallel.distributed import is_coordinator
+
+        if not is_coordinator():
+            return  # coordinator-only emission (the driver role)
         with_names = [
             (
                 self.index.names[cid],
@@ -265,8 +305,18 @@ class VariantsPcaDriver:
 
     def report_io_stats(self) -> None:
         stats = getattr(self.source, "stats", None)
-        if stats is not None:
-            print(stats.report())
+        if stats is None:
+            return
+        if jax.process_count() > 1:
+            from spark_examples_tpu.parallel.distributed import (
+                allreduce_host_stats,
+                is_coordinator,
+            )
+
+            stats = allreduce_host_stats(stats)
+            if not is_coordinator():
+                return
+        print(stats.report())
 
     def stop(self) -> None:
         """No cluster to tear down (sc.stop parity no-op)."""

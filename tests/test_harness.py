@@ -91,3 +91,17 @@ def test_debug_numerics_and_range_guard():
     with debug_numerics():
         with pytest.raises(FloatingPointError):
             _ = jnp.log(jnp.zeros(2)) * 0  # -inf triggers debug_infs
+
+
+def test_graft_entry_contract():
+    """The driver contract: entry() compiles; dryrun_multichip works for
+    even, odd, and prime device counts."""
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (128, 2)
+    for n in (1, 3, 8):
+        ge.dryrun_multichip(n)

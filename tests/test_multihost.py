@@ -1,0 +1,185 @@
+"""Two-process multi-host integration test over real jax.distributed (gloo).
+
+Spawns two subprocesses that each run the framework's own multi-host path:
+``initialize_from_env`` (coordinator env vars), disjoint-shard ingest of the
+same deterministic cohort, per-host partial Gramians, ``allreduce_gramian``
+over DCN, stats merge via ``allreduce_host_stats``, and coordinator-only
+emission — then checks the distributed result equals the single-process
+pipeline bit-for-bit.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from spark_examples_tpu.parallel.distributed import (
+        allreduce_gramian,
+        allreduce_host_stats,
+        initialize_from_env,
+        is_coordinator,
+    )
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.genomics.callsets import CallsetIndex
+    from spark_examples_tpu.genomics.datasets import calls_stream
+    from spark_examples_tpu.genomics.shards import shards_for_references
+    from spark_examples_tpu.arrays.blocks import blocks_from_calls
+    from spark_examples_tpu.ops import gramian_blockwise
+
+    assert initialize_from_env(), "distributed init did not trigger"
+    pid = jax.process_index()
+
+    # Same deterministic cohort on every host; disjoint shard slices.
+    source = synthetic_cohort(10, 80, seed=5)
+    index = CallsetIndex.from_source(source, [DEFAULT_VARIANT_SET_ID])
+    shards = shards_for_references("17:41196311:41277499", 20_000)
+    mine = shards[pid::2]  # round-robin host assignment
+
+    def variants():
+        for s in mine:
+            yield from source.stream_variants(DEFAULT_VARIANT_SET_ID, s)
+
+    calls = calls_stream([variants()], index.indexes)
+    g_local = gramian_blockwise(
+        blocks_from_calls(calls, index.size, 32), index.size
+    )
+    g = allreduce_gramian(g_local)
+    stats = allreduce_host_stats(source.stats)
+
+    # Also drive the FULL driver in multi-host mode: same cohort, the
+    # driver slices the manifest per process itself and emits only on the
+    # coordinator.
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,
+        block_variants=32,
+        output_path=sys.argv[1] + f".driver",
+    )
+    result = VariantsPcaDriver(conf, synthetic_cohort(10, 80, seed=5)).run()
+
+    if is_coordinator():
+        import numpy as np
+        out = {
+            "g_sum": float(np.asarray(g).sum()),
+            "g": np.asarray(g).tolist(),
+            "partitions": stats.partitions,
+            "variants_read": stats.variants_read,
+            "driver_result": [[r[0], r[1], r[2]] for r in result],
+        }
+        with open(sys.argv[1], "w") as f:
+            json.dump(out, f)
+    """
+)
+
+
+@pytest.mark.skipif(
+    os.environ.get("SPARK_EXAMPLES_TPU_SKIP_MULTIHOST") == "1",
+    reason="multihost test disabled",
+)
+def test_two_process_pipeline_matches_single(tmp_path):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    out_file = tmp_path / "result.json"
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "JAX_NUM_PROCESSES": "2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(out_file)],
+            env={**env, "JAX_PROCESS_ID": str(i)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for i in range(2)
+    ]
+    try:
+        logs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    finally:
+        # A dead peer leaves the other blocked in a gloo collective —
+        # never leak it past the test (it would hold the port for the
+        # rest of the session).
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, log[-2000:]
+    result = json.loads(out_file.read_text())
+
+    # Single-process golden over the same cohort/manifest.
+    from spark_examples_tpu.arrays.blocks import blocks_from_calls
+    from spark_examples_tpu.genomics.callsets import CallsetIndex
+    from spark_examples_tpu.genomics.datasets import calls_stream
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.genomics.shards import shards_for_references
+    from spark_examples_tpu.ops import gramian_blockwise
+
+    source = synthetic_cohort(10, 80, seed=5)
+    index = CallsetIndex.from_source(source, [DEFAULT_VARIANT_SET_ID])
+    shards = shards_for_references("17:41196311:41277499", 20_000)
+
+    def variants():
+        for s in shards:
+            yield from source.stream_variants(DEFAULT_VARIANT_SET_ID, s)
+
+    calls = calls_stream([variants()], index.indexes)
+    g = np.asarray(
+        gramian_blockwise(blocks_from_calls(calls, index.size, 32), index.size)
+    )
+    np.testing.assert_array_equal(np.asarray(result["g"]), g)
+    # Stats merged across both hosts cover the full manifest.
+    assert result["partitions"] == len(shards)
+    assert result["variants_read"] == 80
+
+    # Full-driver distributed run equals single-process driver run, and
+    # only the coordinator wrote the TSV.
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,
+        block_variants=32,
+    )
+    single = VariantsPcaDriver(
+        conf, synthetic_cohort(10, 80, seed=5)
+    ).run()
+    dist = result["driver_result"]
+    np.testing.assert_allclose(
+        np.array([r[1:] for r in dist], dtype=float),
+        np.array([r[1:] for r in single]),
+        atol=1e-5,
+    )
+    assert os.path.exists(str(out_file) + ".driver-pca.tsv")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
