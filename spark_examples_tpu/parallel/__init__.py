@@ -18,6 +18,7 @@ Axis conventions:
 
 from spark_examples_tpu.parallel.mesh import make_mesh, DATA_AXIS, MODEL_AXIS
 from spark_examples_tpu.parallel.sharded import (
+    gramian_blockwise_global,
     gramian_variant_parallel,
     sharded_gramian_blockwise,
     sharded_pcoa,
@@ -33,6 +34,7 @@ __all__ = [
     "make_mesh",
     "DATA_AXIS",
     "MODEL_AXIS",
+    "gramian_blockwise_global",
     "gramian_variant_parallel",
     "sharded_gramian_blockwise",
     "sharded_pcoa",

@@ -34,6 +34,7 @@ from spark_examples_tpu.ops.pcoa import normalize_eigvec_signs
 from spark_examples_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 __all__ = [
+    "gramian_blockwise_global",
     "gramian_variant_parallel",
     "sharded_gramian_blockwise",
     "sharded_pcoa",
@@ -118,6 +119,87 @@ def sharded_gramian_blockwise(
     for xb in device_prefetch(padded_blocks(), sharding=x_sharding):
         g = _accum(g, xb)
     return g[:n_samples, :n_samples]
+
+
+def gramian_blockwise_global(
+    local_blocks,
+    n_samples: int,
+    mesh: Mesh,
+    compute_dtype=jnp.float32,
+    accum_dtype=jnp.float32,
+):
+    """Multi-controller blockwise Gramian: one mesh spanning every process.
+
+    The TPU-pod execution model (multi-host GSPMD): each process ingests
+    its own variant columns (its slice of the shard manifest) and
+    contributes them as the process-local shard of a *global* block via
+    ``jax.make_array_from_process_local_data``; the variant axis is sharded
+    over all mesh axes, G stays replicated, and XLA emits the cross-chip
+    reduction over ICI/DCN — no host-side gather of G at all (unlike
+    :func:`spark_examples_tpu.parallel.distributed.allreduce_gramian`,
+    which merges host-local partials through host memory).
+
+    Hosts may ingest different numbers of blocks; every block step is a
+    collective, so liveness and block width are synchronized per block
+    with a tiny host allgather — a process whose stream is exhausted
+    feeds zero columns (inert in the Gramian) at the peers' width until
+    all streams drain, and a width mismatch raises on every process
+    simultaneously (never a one-sided deadlock).
+    """
+    all_axes = tuple(mesh.axis_names)
+    x_sharding = NamedSharding(mesh, P(None, all_axes))
+    g_sharding = NamedSharding(mesh, P(None, None))
+
+    @partial(jax.jit, donate_argnums=(0,), out_shardings=g_sharding)
+    def _accum(g, xb):
+        xf = xb.astype(compute_dtype)
+        return g + jnp.einsum(
+            "nv,mv->nm", xf, xf, preferred_element_type=g.dtype
+        )
+
+    g = jax.device_put(
+        jnp.zeros((n_samples, n_samples), dtype=accum_dtype), g_sharding
+    )
+
+    if jax.process_count() == 1:
+        from spark_examples_tpu.arrays.feed import device_prefetch
+
+        for xg in device_prefetch(local_blocks, sharding=x_sharding):
+            g = _accum(g, xg)
+        return g
+
+    from jax.experimental import multihost_utils
+
+    it = iter(local_blocks)
+    while True:
+        block = next(it, None)
+        # Width sync doubles as the liveness sync: every process learns
+        # every peer's block width (−1 = exhausted) BEFORE any collective
+        # compute, so width mismatches raise on ALL processes together
+        # (one process raising alone would leave peers deadlocked in the
+        # next collective) and an exhausted process learns the width it
+        # must zero-fill.
+        w = -1 if block is None else int(np.asarray(block).shape[1])
+        peer_widths = np.asarray(
+            multihost_utils.process_allgather(np.array([w], np.int64))
+        ).ravel()
+        live = sorted({int(x) for x in peer_widths if x >= 0})
+        if not live:
+            break
+        if len(live) > 1:
+            raise ValueError(
+                "block widths differ across processes in the same step: "
+                f"{live}; every host must stream fixed-width blocks "
+                "(blocks_from_calls pads) with the same --block-variants"
+            )
+        width = live[0]
+        if block is None:
+            block = np.zeros((n_samples, width), np.int8)
+        xg = jax.make_array_from_process_local_data(
+            x_sharding, np.asarray(block)
+        )
+        g = _accum(g, xg)
+    return g
 
 
 def topk_eig_randomized(

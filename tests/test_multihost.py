@@ -183,3 +183,67 @@ def _free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+_GLOBAL_MESH_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from spark_examples_tpu.parallel.distributed import initialize_from_env
+    assert initialize_from_env()
+    from spark_examples_tpu.parallel.sharded import gramian_blockwise_global
+
+    pid = jax.process_index()
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("host", "data"))
+    rng = np.random.default_rng(7)
+    all_blocks = [
+        (rng.random((24, 32)) < 0.3).astype(np.int8) for _ in range(5)
+    ]
+    mine = all_blocks[:3] if pid == 0 else all_blocks[3:]  # uneven
+    g = gramian_blockwise_global(iter(mine), 24, mesh)
+    if pid == 0:
+        x = np.concatenate(all_blocks, axis=1).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(g), x @ x.T)
+        with open(sys.argv[1], "w") as f:
+            json.dump({"ok": True}, f)
+    """
+)
+
+
+def test_global_mesh_gramian_two_processes(tmp_path):
+    """Multi-controller GSPMD: one mesh over 2 processes x 4 devices;
+    uneven per-host block streams; result equals the dense Gramian."""
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(_GLOBAL_MESH_WORKER)
+    out_file = tmp_path / "result.json"
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "JAX_NUM_PROCESSES": "2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(out_file)],
+            env={**env, "JAX_PROCESS_ID": str(i), "XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for i in range(2)
+    ]
+    try:
+        logs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, log[-2000:]
+    assert json.loads(out_file.read_text())["ok"]
