@@ -60,7 +60,9 @@ def _resolve_source(args, references: str):
 
 def _cmd_pca(args) -> int:
     from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.parallel.distributed import initialize_from_env
 
+    initialize_from_env()  # no-op without cluster env vars
     conf = pca_config_from_args(args)
     if not args.variant_set_ids:
         conf.variant_set_ids = [DEFAULT_VARIANT_SET_ID]

@@ -113,9 +113,25 @@ class VariantsPcaDriver:
 
     # -- stage 4: the Gramian ------------------------------------------------
 
+    def _mesh_spans_processes(self) -> bool:
+        if self.mesh is None:
+            return False
+        return (
+            len({d.process_index for d in self.mesh.devices.flat}) > 1
+        )
+
     def _blocks_to_gramian(self, blocks, g_init=None):
         n = self.index.size
-        if self.mesh is not None:
+        if self._mesh_spans_processes():
+            # Pod mode: the mesh covers every process; each host feeds its
+            # manifest slice as the process-local shard of global blocks
+            # and XLA reduces over ICI/DCN — the result is already global.
+            from spark_examples_tpu.parallel.sharded import (
+                gramian_blockwise_global,
+            )
+
+            g = gramian_blockwise_global(blocks, n, self.mesh)
+        elif self.mesh is not None:
             from spark_examples_tpu.parallel.sharded import (
                 sharded_gramian_blockwise,
             )
@@ -138,7 +154,10 @@ class VariantsPcaDriver:
             calls, self.index.size, self.conf.block_variants
         )
         g = self._blocks_to_gramian(blocks)
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and not self._mesh_spans_processes():
+            # Host-local accumulation (no global mesh): merge the per-host
+            # partials over DCN. The global-mesh path needs no merge — its
+            # result is already the global G.
             from spark_examples_tpu.parallel.distributed import (
                 allreduce_gramian,
             )

@@ -207,11 +207,38 @@ _GLOBAL_MESH_WORKER = textwrap.dedent(
     ]
     mine = all_blocks[:3] if pid == 0 else all_blocks[3:]  # uneven
     g = gramian_blockwise_global(iter(mine), 24, mesh)
+    x = np.concatenate(all_blocks, axis=1).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(g), x @ x.T)
+
+    # Full driver in pod mode: mesh spans both processes; the driver
+    # routes to gramian_blockwise_global and skips the host-side merge.
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,
+        block_variants=32,
+    )
+    driver = VariantsPcaDriver(
+        conf, synthetic_cohort(16, 64, seed=11), mesh=mesh
+    )
+    assert driver._mesh_spans_processes()
+    result = driver.run()
+
     if pid == 0:
-        x = np.concatenate(all_blocks, axis=1).astype(np.float32)
-        np.testing.assert_array_equal(np.asarray(g), x @ x.T)
         with open(sys.argv[1], "w") as f:
-            json.dump({"ok": True}, f)
+            json.dump(
+                {
+                    "ok": True,
+                    "driver_result": [[r[0], r[1], r[2]] for r in result],
+                },
+                f,
+            )
     """
 )
 
@@ -246,4 +273,25 @@ def test_global_mesh_gramian_two_processes(tmp_path):
                 p.kill()
     for p, log in zip(procs, logs):
         assert p.returncode == 0, log[-2000:]
-    assert json.loads(out_file.read_text())["ok"]
+    result = json.loads(out_file.read_text())
+    assert result["ok"]
+
+    # Pod-mode driver result equals the single-process driver run.
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,
+        block_variants=32,
+    )
+    single = VariantsPcaDriver(conf, synthetic_cohort(16, 64, seed=11)).run()
+    np.testing.assert_allclose(
+        np.array([r[1:] for r in result["driver_result"]], dtype=float),
+        np.array([r[1:] for r in single]),
+        atol=1e-5,
+    )
