@@ -221,6 +221,12 @@ def topk_eig_randomized(
 
     Returns ``(vecs (N,k), vals (k,))`` ordered by |λ| descending, signs
     normalized.
+
+    Accuracy: on realistic PCoA spectra (population-structure cohorts have
+    a few dominant eigenvalues over a long tail) the subspace converges to
+    ~2e-7 max coordinate error vs dense ``eigh`` within 10 iterations at
+    N=2048 (measured; see tests). The 30-iteration default is headroom for
+    flatter spectra; only near-degenerate λ₁≈λ₂ pairs need more.
     """
     n = c.shape[0]
     p = min(n, k + oversample)
