@@ -149,16 +149,45 @@ def _strip_chr(name: str) -> str:
     return name[3:] if name.startswith("chr") else name
 
 
-def _in_shard(reference_name: str, start: int, shard: Shard) -> bool:
-    """STRICT boundary: record's start coordinate inside the shard window.
+class _SortedIndex:
+    """contig → (sorted start positions, items) with bisect range slicing.
 
-    Contig comparison is on the *raw* reference name with the lenient
-    matching the API applies — "chr17" and "17" address the same contig, in
-    either direction (shard spec and record may each carry the prefix).
+    Both in-memory and file sources serve thousands of shard queries per
+    run (``--all-references`` ≈ 2,900 shards); a linear scan per shard
+    would make ingest O(shards × records). Built once, O(log n) per shard.
     """
-    if _strip_chr(shard.contig) != _strip_chr(reference_name):
-        return False
-    return shard.start <= start < shard.end
+
+    def __init__(self, by_contig: dict):
+        self._by = by_contig
+
+    @staticmethod
+    def build(items, key_fn) -> "_SortedIndex":
+        tmp: dict = {}
+        for it in items:
+            contig, start = key_fn(it)
+            tmp.setdefault(_strip_chr(contig), []).append((start, it))
+        by = {}
+        for contig, pairs in tmp.items():
+            pairs.sort(key=lambda p: p[0])
+            by[contig] = ([p[0] for p in pairs], [p[1] for p in pairs])
+        return _SortedIndex(by)
+
+    def slice(self, shard: Shard) -> list:
+        """STRICT boundary: items whose start is in [shard.start, shard.end).
+
+        This IS the framework's STRICT-shard-boundary contract (the
+        ``ShardBoundary.Requirement.STRICT`` of VariantsRDD.scala:210-211):
+        adjacent windows + half-open bisect bounds ⇒ every record is
+        yielded by exactly one shard. Contig matching is lenient on the
+        "chr" prefix in either direction ("chr17" and "17" address the
+        same contig), applied at both build and query time.
+        """
+        import bisect
+
+        starts, items = self._by.get(_strip_chr(shard.contig), ([], []))
+        lo = bisect.bisect_left(starts, shard.start)
+        hi = bisect.bisect_left(starts, shard.end)
+        return items[lo:hi]
 
 
 class FixtureSource:
@@ -187,6 +216,20 @@ class FixtureSource:
         # exercises the retry/elasticity path the reference delegates to
         # Spark task re-execution.
         self._fail_once = set(fail_shards)
+        self._variant_idx: Optional[_SortedIndex] = None
+        self._read_idx: Optional[_SortedIndex] = None
+
+    @staticmethod
+    def _variant_key(item):
+        if isinstance(item, Variant):
+            return item.contig, item.start
+        return item["reference_name"], item["start"]
+
+    @staticmethod
+    def _read_key(item):
+        if isinstance(item, Read):
+            return item.reference_name, item.position
+        return item["reference_name"], item["position"]
 
     def list_callsets(self, variant_set_id: str) -> List[Callset]:
         self.stats.add(requests=1)
@@ -204,18 +247,16 @@ class FixtureSource:
             self._fail_once.discard(shard)
             self.stats.add(io_exceptions=1)
             raise IOError(f"injected stream failure for {shard}")
-        for item in self._variants:
+        if self._variant_idx is None:
+            self._variant_idx = _SortedIndex.build(
+                self._variants, self._variant_key
+            )
+        for item in self._variant_idx.slice(shard):
             if isinstance(item, Variant):
                 v = item
-                raw_name, start = v.contig, v.start
             else:
                 if variant_set_id and item.get("variant_set_id", variant_set_id) != variant_set_id:
                     continue
-                raw_name, start = item["reference_name"], item["start"]
-                v = None
-            if not _in_shard(raw_name, start, shard):
-                continue
-            if v is None:
                 v = variant_from_record(item)
                 if v is None:  # dropped contig
                     continue
@@ -228,15 +269,15 @@ class FixtureSource:
         self, read_group_set_id: str, shard: Shard
     ) -> Iterator[Read]:
         self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
-        for item in self._reads:
+        if self._read_idx is None:
+            self._read_idx = _SortedIndex.build(self._reads, self._read_key)
+        for item in self._read_idx.slice(shard):
             r = item if isinstance(item, Read) else read_from_record(item)
             if (
                 read_group_set_id
                 and r.read_group_set_id
                 and r.read_group_set_id != read_group_set_id
             ):
-                continue
-            if not _in_shard(r.reference_name, r.position, shard):
                 continue
             self.stats.add(reads_read=1)
             yield r
@@ -293,8 +334,8 @@ class JsonlSource:
         # ingest O(shards × records). Parse once into per-contig lists
         # sorted by start; each shard reads its [start, end) slice via
         # binary search.
-        self._variant_index: Optional[dict] = None
-        self._read_index: Optional[dict] = None
+        self._variant_index: Optional[_SortedIndex] = None
+        self._read_index: Optional[_SortedIndex] = None
 
     def _open(self, name: str):
         path = os.path.join(self.root, name)
@@ -302,39 +343,22 @@ class JsonlSource:
             return gzip.open(path + ".gz", "rt")
         return open(path, "rt")
 
-    @staticmethod
-    def _build_index(f, pos_field: str) -> dict:
-        """contig → (sorted start-position list, records sorted by start)."""
-        by_contig: dict = {}
-        for line in f:
-            rec = json.loads(line)
-            by_contig.setdefault(_strip_chr(rec["reference_name"]), []).append(
-                rec
-            )
-        out = {}
-        for contig, recs in by_contig.items():
-            recs.sort(key=lambda r: r[pos_field])
-            out[contig] = ([r[pos_field] for r in recs], recs)
-        return out
-
-    def _shard_slice(self, index: dict, pos_field: str, shard: Shard) -> list:
-        import bisect
-
-        starts, recs = index.get(_strip_chr(shard.contig), ([], []))
-        lo = bisect.bisect_left(starts, shard.start)
-        hi = bisect.bisect_left(starts, shard.end)
-        return recs[lo:hi]
-
-    def _variants_index(self) -> dict:
+    def _variants_index(self) -> _SortedIndex:
         if self._variant_index is None:
             with self._open("variants.jsonl") as f:
-                self._variant_index = self._build_index(f, "start")
+                self._variant_index = _SortedIndex.build(
+                    (json.loads(line) for line in f),
+                    lambda r: (r["reference_name"], r["start"]),
+                )
         return self._variant_index
 
-    def _reads_index(self) -> dict:
+    def _reads_index(self) -> _SortedIndex:
         if self._read_index is None:
             with self._open("reads.jsonl") as f:
-                self._read_index = self._build_index(f, "position")
+                self._read_index = _SortedIndex.build(
+                    (json.loads(line) for line in f),
+                    lambda r: (r["reference_name"], r["position"]),
+                )
         return self._read_index
 
     def list_callsets(self, variant_set_id: str) -> List[Callset]:
@@ -352,7 +376,7 @@ class JsonlSource:
         self, variant_set_id: str, shard: Shard
     ) -> Iterator[Variant]:
         self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
-        for rec in self._shard_slice(self._variants_index(), "start", shard):
+        for rec in self._variants_index().slice(shard):
             if (
                 variant_set_id
                 and rec.get("variant_set_id", variant_set_id)
@@ -369,7 +393,7 @@ class JsonlSource:
         self, read_group_set_id: str, shard: Shard
     ) -> Iterator[Read]:
         self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
-        for rec in self._shard_slice(self._reads_index(), "position", shard):
+        for rec in self._reads_index().slice(shard):
             rgs = rec.get("read_group_set_id", "")
             if rgs and read_group_set_id and rgs != read_group_set_id:
                 continue
