@@ -87,6 +87,26 @@ def _cmd_generate_fixture(args) -> int:
         seed=args.fixture_seed,
         variant_set_id=(args.variant_set_ids or [DEFAULT_VARIANT_SET_ID])[0],
     )
+    if args.fixture_tumor_normal:
+        # Tumor/normal pair for reads-example 4.
+        from spark_examples_tpu.genomics.fixtures import synthetic_tumor_normal
+
+        pair = synthetic_tumor_normal(
+            args.fixture_tumor_normal,
+            references=args.reads_references or "1:100000000:100002000",
+            seed=args.fixture_seed,
+        )
+        src.add_reads(pair.reads_records())
+    elif args.fixture_reads:
+        # Same directory serves reads examples 1-3 via --input-path; note
+        # the region must cover the example's query window
+        # (--reads-references defaults to --references).
+        reads_src = synthetic_reads(
+            args.fixture_reads,
+            references=args.reads_references or args.references,
+            seed=args.fixture_seed,
+        )
+        src.add_reads(reads_src.reads_records())
     src.dump(args.out)
     print(f"Wrote cohort to {args.out}")
     return 0
@@ -95,10 +115,11 @@ def _cmd_generate_fixture(args) -> int:
 def _resolve_reads_source(args, references: str):
     """Returns (source, read_group_set_id)."""
     from spark_examples_tpu.genomics.fixtures import FIXTURE_READSET_ID
-    from spark_examples_tpu.models.search_reads import Examples
 
     if args.input_path:
-        return JsonlSource(args.input_path), Examples.GOOGLE_EXAMPLE_READSET
+        # Local cohorts default to no readset filter (serve whatever the
+        # directory holds); --read-group-set-id narrows it.
+        return JsonlSource(args.input_path), (args.read_group_set_id or "")
     if args.fixture_reads:
         return (
             synthetic_reads(
@@ -165,18 +186,20 @@ def _cmd_reads_example(args) -> int:
         )
         print(f"Wrote {out}")
     elif n == 4:
+        from spark_examples_tpu.genomics.fixtures import (
+            NORMAL_READSET_ID,
+            TUMOR_READSET_ID,
+            synthetic_tumor_normal,
+        )
+
         refs = args.references or "1:100000000:101000000"
         if args.input_path:
             source = JsonlSource(args.input_path)
-            normal_id = sr.Examples.GOOGLE_DREAM_SET3_NORMAL
-            tumor_id = sr.Examples.GOOGLE_DREAM_SET3_TUMOR
+            # Local cohorts default to the fixture pair ids (the DREAM API
+            # ids remain available via the flags).
+            normal_id = args.normal_id or NORMAL_READSET_ID
+            tumor_id = args.tumor_id or TUMOR_READSET_ID
         elif args.fixture_reads:
-            from spark_examples_tpu.genomics.fixtures import (
-                NORMAL_READSET_ID,
-                TUMOR_READSET_ID,
-                synthetic_tumor_normal,
-            )
-
             source = synthetic_tumor_normal(
                 args.fixture_reads, references=refs, seed=args.fixture_seed
             )
@@ -196,6 +219,14 @@ def _cmd_reads_example(args) -> int:
         print(f"Wrote {out}")
     else:
         raise SystemExit(f"unknown reads example {n}")
+    stats = getattr(source, "stats", None)
+    if stats is not None and stats.reads_read == 0:
+        print(
+            "WARNING: no reads matched the queried region/readset — check "
+            "that the cohort covers the example's region (--references) "
+            "and readset id (--read-group-set-id / --normal-id/--tumor-id)",
+            file=sys.stderr,
+        )
     return 0
 
 
@@ -237,6 +268,24 @@ def build_parser() -> argparse.ArgumentParser:
     add_pca_flags(gen)
     _add_fixture_flags(gen)
     gen.add_argument("--out", required=True)
+    gen.add_argument(
+        "--fixture-reads",
+        type=int,
+        default=None,
+        help="Also write reads.jsonl with this many synthetic reads",
+    )
+    gen.add_argument(
+        "--reads-references",
+        default=None,
+        help="Region for generated reads (defaults to --references)",
+    )
+    gen.add_argument(
+        "--fixture-tumor-normal",
+        type=int,
+        default=None,
+        help="Write a tumor/normal reads pair (for reads-example 4) "
+        "instead of a single readset",
+    )
     gen.set_defaults(fn=_cmd_generate_fixture)
 
     from spark_examples_tpu.models.search_variants import (
@@ -271,6 +320,13 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="Run against synthetic reads",
     )
+    reads.add_argument(
+        "--read-group-set-id",
+        default=None,
+        help="Readset id filter (default: all readsets in the cohort)",
+    )
+    reads.add_argument("--normal-id", default=None)
+    reads.add_argument("--tumor-id", default=None)
     reads.set_defaults(references=None, fn=_cmd_reads_example)
 
     bridge = sub.add_parser(

@@ -282,6 +282,14 @@ class FixtureSource:
             self.stats.add(reads_read=1)
             yield r
 
+    def add_reads(self, reads: Sequence) -> None:
+        """Attach read records so one cohort serves both pipelines."""
+        self._reads = list(reads)
+        self._read_idx = None
+
+    def reads_records(self) -> list:
+        return list(self._reads)
+
     def dump(self, root: str) -> None:
         """Write the cohort as a JSONL directory readable by JsonlSource.
 
