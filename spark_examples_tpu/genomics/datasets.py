@@ -12,7 +12,9 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence
 
-from spark_examples_tpu.genomics.hashing import variant_identity
+import itertools
+
+from spark_examples_tpu.genomics.hashing import variant_identities
 from spark_examples_tpu.genomics.types import Variant, has_variation
 
 __all__ = [
@@ -57,14 +59,19 @@ def carrying_sample_indices(
     return out
 
 
-def identity(variant: Variant) -> str:
-    return variant_identity(
-        variant.contig,
-        variant.start,
-        variant.end,
-        variant.reference_bases,
-        variant.alternate_bases,
-    )
+def _keyed(stream, chunk: int = 65536):
+    """Yield (identity, variant) lazily, hashing in bounded chunks.
+
+    Keeps the one-native-call-per-chunk batching win without materializing
+    the stream (multi-million-variant cohorts must not be held in memory
+    to be joined).
+    """
+    it = iter(stream)
+    while True:
+        block = list(itertools.islice(it, chunk))
+        if not block:
+            return
+        yield from zip(variant_identities(block), block)
 
 
 def join_datasets(
@@ -76,10 +83,9 @@ def join_datasets(
     both datasets.
     """
     left: Dict[str, List[int]] = {}
-    for v in a:
-        left[identity(v)] = carrying_sample_indices(v, indexes)
-    for v in b:
-        key = identity(v)
+    for key, v in _keyed(a):
+        left[key] = carrying_sample_indices(v, indexes)
+    for key, v in _keyed(b):
         if key in left:
             yield left[key] + carrying_sample_indices(v, indexes)
 
@@ -96,8 +102,7 @@ def merge_datasets(
     groups: Dict[str, List[int]] = {}
     counts: Dict[str, int] = {}
     for stream in streams:
-        for v in stream:
-            key = identity(v)
+        for key, v in _keyed(stream):
             counts[key] = counts.get(key, 0) + 1
             groups.setdefault(key, []).extend(
                 carrying_sample_indices(v, indexes)

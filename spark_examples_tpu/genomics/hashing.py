@@ -15,7 +15,7 @@ from __future__ import annotations
 import ctypes
 from typing import Optional
 
-__all__ = ["murmur3_x64_128", "variant_identity"]
+__all__ = ["murmur3_x64_128", "variant_identity", "variant_identities"]
 
 
 _UNRESOLVED = object()
@@ -120,6 +120,61 @@ def _murmur3_py(data: bytes, seed: int = 0) -> bytes:
     return h1.to_bytes(8, "little") + h2.to_bytes(8, "little")
 
 
+def _identity_payload(
+    contig: str,
+    start: int,
+    end: int,
+    reference_bases: Optional[str],
+    alternate_bases,
+) -> bytes:
+    alt = "".join(alternate_bases) if alternate_bases else ""
+    ref = reference_bases or ""
+    return (
+        contig.encode("utf-8")
+        + int(start).to_bytes(8, "little", signed=True)
+        + int(end).to_bytes(8, "little", signed=True)
+        + ref.encode("utf-8")
+        + alt.encode("utf-8")
+    )
+
+
+def variant_identities(variants) -> list:
+    """Batch identity hashing — the join/merge hot path.
+
+    One native call over a concatenated payload buffer instead of one
+    ctypes round-trip per variant; falls back to per-variant hashing when
+    the native core is unavailable.
+    """
+    variants = list(variants)
+    lib = _native()
+    if lib is None or not variants:
+        return [
+            murmur3_x64_128(
+                _identity_payload(
+                    v.contig, v.start, v.end,
+                    v.reference_bases, v.alternate_bases,
+                )
+            ).hex()
+            for v in variants
+        ]
+    import itertools
+
+    payloads = [
+        _identity_payload(
+            v.contig, v.start, v.end, v.reference_bases, v.alternate_bases
+        )
+        for v in variants
+    ]
+    offsets = (ctypes.c_int64 * (len(payloads) + 1))(
+        *itertools.accumulate(map(len, payloads), initial=0)
+    )
+    blob = b"".join(payloads)
+    out = ctypes.create_string_buffer(16 * len(payloads))
+    lib.murmur3_x64_128_batch(blob, offsets, len(payloads), 0, out)
+    raw = out.raw
+    return [raw[i * 16 : (i + 1) * 16].hex() for i in range(len(payloads))]
+
+
 def variant_identity(
     contig: str,
     start: int,
@@ -133,13 +188,6 @@ def variant_identity(
     little-endian int64 start, int64 end, UTF-8 referenceBases (null → ""),
     UTF-8 concatenated alternateBases (absent → "").
     """
-    alt = "".join(alternate_bases) if alternate_bases else ""
-    ref = reference_bases or ""
-    payload = (
-        contig.encode("utf-8")
-        + int(start).to_bytes(8, "little", signed=True)
-        + int(end).to_bytes(8, "little", signed=True)
-        + ref.encode("utf-8")
-        + alt.encode("utf-8")
-    )
-    return murmur3_x64_128(payload).hex()
+    return murmur3_x64_128(
+        _identity_payload(contig, start, end, reference_bases, alternate_bases)
+    ).hex()

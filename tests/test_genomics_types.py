@@ -139,3 +139,35 @@ class TestReadBuild:
         )
         assert r.cigar == "2S98M5N"
         assert r.key() == ("21", 1000)
+
+
+class TestBatchIdentities:
+    def test_batch_matches_single(self):
+        from spark_examples_tpu.genomics.hashing import (
+            variant_identities,
+            variant_identity,
+        )
+        from spark_examples_tpu.genomics.types import Variant
+
+        vs = [
+            Variant.build("chr17", 100 + i, 101 + i, "ACGT"[i % 4],
+                          alternate_bases=["T", "G"][: 1 + i % 2])
+            for i in range(20)
+        ]
+        batch = variant_identities(vs)
+        singles = [
+            variant_identity(v.contig, v.start, v.end,
+                             v.reference_bases, v.alternate_bases)
+            for v in vs
+        ]
+        assert batch == singles
+
+    def test_batch_matches_fallback(self, monkeypatch):
+        import spark_examples_tpu.genomics.hashing as H
+        from spark_examples_tpu.genomics.types import Variant
+
+        vs = [Variant.build("13", i, i + 1, "A") for i in range(7)]
+        native = H.variant_identities(vs)
+        monkeypatch.setattr(H, "_native_lib", None)
+        fallback = H.variant_identities(vs)
+        assert native == fallback
