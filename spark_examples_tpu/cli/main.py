@@ -34,6 +34,12 @@ def _add_fixture_flags(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument("--fixture-variants", type=int, default=1000)
     p.add_argument("--fixture-seed", type=int, default=0)
+    p.add_argument(
+        "--fixture-sparse-calls",
+        action="store_true",
+        help="Omit hom-ref calls from generated records (~10x faster at "
+        "large N x V; identical pipeline results)",
+    )
 
 
 def _resolve_source(args, references: str):
@@ -49,6 +55,7 @@ def _resolve_source(args, references: str):
             args.fixture_variants,
             references=references,
             seed=args.fixture_seed,
+            sparse_calls=args.fixture_sparse_calls,
             variant_set_id=(args.variant_set_ids or [DEFAULT_VARIANT_SET_ID])[0],
         )
     raise SystemExit(
@@ -85,6 +92,7 @@ def _cmd_generate_fixture(args) -> int:
         args.fixture_variants,
         references=args.references,
         seed=args.fixture_seed,
+        sparse_calls=args.fixture_sparse_calls,
         variant_set_id=(args.variant_set_ids or [DEFAULT_VARIANT_SET_ID])[0],
     )
     if args.fixture_tumor_normal:

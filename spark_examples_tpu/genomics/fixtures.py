@@ -45,6 +45,8 @@ def synthetic_cohort(
     seed: int = 0,
     population_structure: int = 2,
     dropped_contig_every: Optional[int] = None,
+    reference_blocks_every: Optional[int] = None,
+    sparse_calls: bool = False,
     stats=None,
 ) -> FixtureSource:
     """Build an in-memory cohort with latent population structure.
@@ -56,6 +58,17 @@ def synthetic_cohort(
 
     ``dropped_contig_every``: every k-th variant is emitted on contig
     "chrX_alt" and must be dropped by ingest.
+
+    ``reference_blocks_every``: every k-th record is a gVCF-style
+    reference-matching block (referenceBases "N", no alternates, no calls)
+    — the record class the Platinum Genomes sets interleave with variants
+    and the search-variants examples count separately
+    (SearchVariantsExample.scala:57-63, 104-112).
+
+    ``sparse_calls``: omit hom-ref (0/0) calls from records — ~10× faster
+    generation and memory at large N×V with identical pipeline results
+    (non-carrying calls never reach the Gramian; N comes from the callset
+    index, not from call lists). Dense is the default for realism.
     """
     rng = np.random.default_rng(seed)
     regions = parse_references(references)
@@ -84,16 +97,31 @@ def synthetic_cohort(
                 pos = start + off
                 break
             off -= end - start
+        reference_name = (
+            "chrX_alt"
+            if dropped_contig_every and vi % dropped_contig_every == 0
+            else contig
+        )
+        if reference_blocks_every and vi % reference_blocks_every == 0:
+            records.append(
+                {
+                    "reference_name": reference_name,
+                    "start": pos,
+                    "end": pos + int(rng.integers(1, 200)),
+                    "reference_bases": "N",
+                    "variant_set_id": variant_set_id,
+                    "calls": [],
+                }
+            )
+            continue
         ref_base = _BASES[rng.integers(0, 4)]
         alt_base = _BASES[(rng.integers(1, 4) + _BASES.index(ref_base)) % 4]
         # Per-group allele frequency: structured signal for the PCoA.
         group_af = rng.beta(0.4, 1.2, size=population_structure)
         carrier_p = group_af[groups]
         gts = rng.random(n_samples) < carrier_p
-        reference_name = (
-            "chrX_alt"
-            if dropped_contig_every and vi % dropped_contig_every == 0
-            else contig
+        sample_range = (
+            np.nonzero(gts)[0] if sparse_calls else range(n_samples)
         )
         calls = [
             {
@@ -102,7 +130,7 @@ def synthetic_cohort(
                 "genotype": [1, 1] if (gts[s] and rng.random() < 0.3)
                 else ([0, 1] if gts[s] else [0, 0]),
             }
-            for s in range(n_samples)
+            for s in sample_range
         ]
         af = float(gts.mean())
         records.append(

@@ -181,3 +181,24 @@ class TestReviewRegressions:
             np.abs(np.asarray(rand_v)) - np.abs(np.asarray(exact_v))
         ).max()
         assert err < 1e-4, err
+
+
+def test_cli_pca_with_mesh_flag(capsys, tmp_path):
+    from spark_examples_tpu.cli.main import main
+
+    rc = main(
+        [
+            "pca",
+            "--fixture-samples",
+            "13",
+            "--fixture-variants",
+            "90",
+            "--mesh-shape",
+            "data:4,model:2",
+            "--output-path",
+            str(tmp_path / "mesh"),
+        ]
+    )
+    assert rc == 0
+    assert "Matrix size: 13" in capsys.readouterr().out
+    assert (tmp_path / "mesh-pca.tsv").exists()

@@ -85,3 +85,22 @@ def test_cli_search_variants(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "records that overlap Klotho" in out
+
+
+def test_examples_on_generated_gvcf_cohort(capsys):
+    """Generated cohorts with gVCF reference blocks exercise both count
+    branches of the example drivers."""
+    from spark_examples_tpu.genomics.fixtures import synthetic_cohort
+
+    src = synthetic_cohort(
+        5,
+        20,
+        references="13:33628000:33629000",
+        reference_blocks_every=4,
+    )
+    lines = search_variants_klotho(
+        src, "fixture-platinum", references="13:33628000:33629000"
+    )
+    assert lines[0] == "We have 20 records that overlap Klotho."
+    assert lines[1] == "But only 15 records are of a variant."
+    assert lines[2] == "The other 5 records are reference-matching blocks."
