@@ -55,14 +55,6 @@ def tpu_time(blocks):
     import jax
     import jax.numpy as jnp
 
-    # BENCH_INT8=1: int8×int8→int32 einsum — on TPU the int matmul path
-    # can outrun f32 for 0/1 operands; numerically exact either way
-    # (tests/test_harness.py::test_int8_int32_gramian_exact).
-    int8 = os.environ.get("BENCH_INT8") == "1"
-    dt = dict(
-        compute_dtype=jnp.int8, accum_dtype=jnp.int32
-    ) if int8 else {}
-
     # Persistent compilation cache: the N≈2500 eigh compile is minutes the
     # first time; cached thereafter.
     jax.config.update(
@@ -71,17 +63,36 @@ def tpu_time(blocks):
     )
     from spark_examples_tpu.ops import gramian_blockwise, pcoa
 
-    # Warm-up: compile both programs on a throwaway pass.
-    _log(f"bench: compiling (N={N_SAMPLES}, V={N_VARIANTS}, int8={int8}) ...")
-    g = gramian_blockwise(blocks[:1], N_SAMPLES, **dt)
-    pcoa(g.astype(jnp.float32), NUM_PC)[0].block_until_ready()
-    _log("bench: compiled; timing steady-state")
+    # Two numerically-exact dtype paths for the same computation: f32
+    # matmul (exact for 0/1 products below 2^24) and int8×int8→int32 (the
+    # TPU integer-MXU path). Measure both, report the faster — forced via
+    # BENCH_INT8=1/0 if desired.
+    modes = {
+        "f32": {},
+        "int8": dict(compute_dtype=jnp.int8, accum_dtype=jnp.int32),
+    }
+    forced = os.environ.get("BENCH_INT8")
+    if forced is not None:
+        modes = {"int8": modes["int8"]} if forced == "1" else {
+            "f32": modes["f32"]
+        }
 
-    t0 = time.perf_counter()
-    g = gramian_blockwise(blocks, N_SAMPLES, **dt)
-    coords, _ = pcoa(g.astype(jnp.float32), NUM_PC)
-    coords.block_until_ready()
-    return time.perf_counter() - t0, np.asarray(coords)
+    best = None
+    for name, dt in modes.items():
+        _log(f"bench: compiling {name} (N={N_SAMPLES}, V={N_VARIANTS}) ...")
+        g = gramian_blockwise(blocks[:1], N_SAMPLES, **dt)
+        pcoa(g.astype(jnp.float32), NUM_PC)[0].block_until_ready()
+
+        t0 = time.perf_counter()
+        g = gramian_blockwise(blocks, N_SAMPLES, **dt)
+        coords, _ = pcoa(g.astype(jnp.float32), NUM_PC)
+        coords.block_until_ready()
+        dt_s = time.perf_counter() - t0
+        _log(f"bench: {name} steady-state {dt_s:.3f}s")
+        if best is None or dt_s < best[0]:
+            best = (dt_s, np.asarray(coords), name)
+    _log(f"bench: using {best[2]} path")
+    return best[0], best[1]
 
 
 def cpu_reference_time(blocks):
