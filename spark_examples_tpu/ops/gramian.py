@@ -123,9 +123,17 @@ def _gramian_blockwise_pallas(blocks, n_samples, device=None):
     from spark_examples_tpu.ops.pallas_gramian import (
         BLOCK_N,
         BLOCK_V,
+        _mirror_lower,
+        _sym_accumulate_lower,
         gramian_accumulate_pallas,
+        pallas_mode,
     )
 
+    sym = pallas_mode() == "sym"
+    # Sym mode accumulates the lower triangle only across all blocks and
+    # mirrors ONCE at the end (per-block mirroring would spend O(N²) HBM
+    # traffic per block on a bandwidth-bound kernel).
+    accumulate = _sym_accumulate_lower if sym else gramian_accumulate_pallas
     n_pad = round_up_multiple(n_samples, BLOCK_N)
 
     def padded():
@@ -140,5 +148,7 @@ def _gramian_blockwise_pallas(blocks, n_samples, device=None):
     if device is not None:
         g = jax.device_put(g, device)
     for xb in device_prefetch(padded(), device=device):
-        g = gramian_accumulate_pallas(g, xb)
+        g = accumulate(g, xb)
+    if sym:
+        g = _mirror_lower(g)
     return g[:n_samples, :n_samples]

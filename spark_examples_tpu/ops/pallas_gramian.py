@@ -24,8 +24,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["gramian_accumulate_pallas", "pallas_enabled", "BLOCK_N", "BLOCK_V"]
+__all__ = [
+    "gramian_accumulate_pallas",
+    "gramian_accumulate_pallas_sym",
+    "pallas_enabled",
+    "BLOCK_N",
+    "BLOCK_V",
+]
 
 # Default tile sizes: 256×512 int8 X tiles (128 KB VMEM each) and a 256×256
 # f32 G tile (256 KB) fit VMEM comfortably with double buffering.
@@ -34,11 +41,31 @@ BLOCK_V = 512
 
 
 def pallas_enabled() -> bool:
-    return os.environ.get("SPARK_EXAMPLES_TPU_PALLAS") == "1"
+    return pallas_mode() is not None
 
 
-def _kernel(xi_ref, xj_ref, g_in_ref, g_out_ref):
-    k = pl.program_id(2)
+def pallas_mode():
+    """None (off) | "dense" | "sym", from SPARK_EXAMPLES_TPU_PALLAS.
+
+    "1"/"dense" selects :func:`gramian_accumulate_pallas`; "sym" the
+    triangle-only :func:`gramian_accumulate_pallas_sym`.
+    """
+    val = os.environ.get("SPARK_EXAMPLES_TPU_PALLAS", "")
+    if val in ("1", "dense"):
+        return "dense"
+    if val == "sym":
+        return "sym"
+    if val in ("", "0"):
+        return None
+    raise ValueError(
+        f"SPARK_EXAMPLES_TPU_PALLAS={val!r}: expected '1'/'dense', 'sym', "
+        "or unset/'0'"
+    )
+
+
+def _accumulate_body(k, xi_ref, xj_ref, g_in_ref, g_out_ref):
+    """Shared tile body: init the output tile from the accumulator on the
+    first k step, then add the (i, j) tile product."""
 
     @pl.when(k == 0)
     def _init():
@@ -46,9 +73,11 @@ def _kernel(xi_ref, xj_ref, g_in_ref, g_out_ref):
 
     xi = xi_ref[:].astype(jnp.float32)
     xj = xj_ref[:].astype(jnp.float32)
-    g_out_ref[:] += jnp.dot(
-        xi, xj.T, preferred_element_type=jnp.float32
-    )
+    g_out_ref[:] += jnp.dot(xi, xj.T, preferred_element_type=jnp.float32)
+
+
+def _kernel(xi_ref, xj_ref, g_in_ref, g_out_ref):
+    _accumulate_body(pl.program_id(2), xi_ref, xj_ref, g_in_ref, g_out_ref)
 
 
 @partial(
@@ -88,3 +117,96 @@ def gramian_accumulate_pallas(
         interpret=interpret,
     )(x_block, x_block, g)
     return out
+
+
+def _sym_kernel(i_ref, j_ref, xi_ref, xj_ref, g_in_ref, g_out_ref):
+    _accumulate_body(pl.program_id(1), xi_ref, xj_ref, g_in_ref, g_out_ref)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("block_n", "block_v", "interpret"),
+    donate_argnums=(0,),
+)
+def _sym_accumulate_lower(
+    g,
+    x_block,
+    block_n: int = BLOCK_N,
+    block_v: int = BLOCK_V,
+    interpret: bool = False,
+):
+    """One syrk-style step on the LOWER triangle only.
+
+    The grid enumerates the T(T+1)/2 tile pairs with j ≤ i via
+    scalar-prefetch index maps; only the lower triangle of the result is
+    defined (upper tiles are never visited — unvisited output tiles are
+    undefined, and the kernel never reads them either, so garbage cannot
+    propagate). Streaming callers chain these and mirror ONCE at the end
+    (:func:`_mirror_lower`) instead of paying O(N²) mirror traffic per
+    block.
+    """
+    n, v = x_block.shape
+    assert n % block_n == 0 and v % block_v == 0, (n, v, block_n, block_v)
+    t, kk = n // block_n, v // block_v
+    pairs = [(i, j) for i in range(t) for j in range(i + 1)]
+    i_idx = jnp.asarray([p[0] for p in pairs], jnp.int32)
+    j_idx = jnp.asarray([p[1] for p in pairs], jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(len(pairs), kk),
+        in_specs=[
+            pl.BlockSpec(
+                (block_n, block_v), lambda p, k, i_ref, j_ref: (i_ref[p], k)
+            ),
+            pl.BlockSpec(
+                (block_n, block_v), lambda p, k, i_ref, j_ref: (j_ref[p], k)
+            ),
+            pl.BlockSpec(
+                (block_n, block_n),
+                lambda p, k, i_ref, j_ref: (i_ref[p], j_ref[p]),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_n, block_n),
+            lambda p, k, i_ref, j_ref: (i_ref[p], j_ref[p]),
+        ),
+    )
+    return pl.pallas_call(
+        _sym_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, n), jnp.float32),
+        interpret=interpret,
+    )(i_idx, j_idx, x_block, x_block, g)
+
+
+@jax.jit
+def _mirror_lower(g):
+    """Lower-triangle-valid accumulator → full symmetric matrix."""
+    return jnp.tril(g) + jnp.tril(g, -1).T
+
+
+def gramian_accumulate_pallas_sym(
+    g,
+    x_block,
+    block_n: int = BLOCK_N,
+    block_v: int = BLOCK_V,
+    interpret: bool = False,
+):
+    """Symmetric (syrk-style) accumulation: only tiles with j ≤ i compute.
+
+    ≈2× fewer MXU tile matmuls than the dense grid of
+    :func:`gramian_accumulate_pallas`; the mirror is one ``tril + trilᵀ``
+    pass. Same exactness argument as the dense kernel.
+
+    Precondition: ``g`` must be symmetric (a Gramian accumulator always
+    is) — only its lower triangle is read, and the upper half of the
+    result is reconstructed from the lower, so a non-symmetric ``g``'s
+    upper contents would be silently replaced. Streaming callers should
+    chain :func:`_sym_accumulate_lower` and mirror once instead.
+    """
+    return _mirror_lower(
+        _sym_accumulate_lower(
+            g, x_block, block_n=block_n, block_v=block_v, interpret=interpret
+        )
+    )

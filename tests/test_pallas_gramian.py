@@ -53,3 +53,41 @@ def test_blockwise_pallas_path_matches(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(g), np.asarray(gramian(x)), rtol=1e-6
     )
+
+
+def test_pallas_sym_matches_einsum():
+    from spark_examples_tpu.ops.pallas_gramian import (
+        gramian_accumulate_pallas_sym,
+    )
+
+    rng = np.random.default_rng(3)
+    n, v = 768, 1024  # 3x2 tile grid — even and odd tile rows
+    x = (rng.random((n, v)) < 0.3).astype(np.int8)
+    g0 = rng.random((n, n)).astype(np.float32)
+    g0 = g0 + g0.T  # accumulator must be symmetric (G always is)
+
+    got = gramian_accumulate_pallas_sym(
+        jnp.asarray(g0), jnp.asarray(x), interpret=True
+    )
+    want = g0 + np.asarray(gramian(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_blockwise_sym_dispatch(monkeypatch):
+    import spark_examples_tpu.ops.pallas_gramian as pg
+    from spark_examples_tpu.ops import gramian_blockwise
+
+    monkeypatch.setenv("SPARK_EXAMPLES_TPU_PALLAS", "sym")
+    orig = pg._sym_accumulate_lower
+    monkeypatch.setattr(
+        pg,
+        "_sym_accumulate_lower",
+        lambda g, x: orig(g, x, interpret=True),
+    )
+    rng = np.random.default_rng(4)
+    x = (rng.random((100, 700)) < 0.3).astype(np.int8)
+    blocks = [x[:, :300], x[:, 300:]]
+    g = gramian_blockwise(iter(blocks), 100, use_pallas=True)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(gramian(x)), rtol=1e-6
+    )
