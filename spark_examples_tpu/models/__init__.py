@@ -1,1 +1,27 @@
 """The pipelines ("apps"): PCA driver and the search examples — L3 parity."""
+
+__all__ = [
+    "VariantsPcaDriver",
+    "GoogleGenomicsPublicData",
+    "search_variants_brca1",
+    "search_variants_klotho",
+]
+
+
+def __getattr__(name):
+    # Lazy re-exports: importing the PCA driver pulls in jax; host-only
+    # CLI paths (fixture generation, search drivers, --help) must stay
+    # light, so resolution is deferred to first attribute access.
+    if name == "VariantsPcaDriver":
+        from spark_examples_tpu.models.pca import VariantsPcaDriver
+
+        return VariantsPcaDriver
+    if name in (
+        "GoogleGenomicsPublicData",
+        "search_variants_brca1",
+        "search_variants_klotho",
+    ):
+        from spark_examples_tpu.models import search_variants
+
+        return getattr(search_variants, name)
+    raise AttributeError(name)

@@ -9,10 +9,13 @@ einsum materializes the upcast when the operand is int8), which matters
 because HBM bandwidth, not MXU FLOPs, bounds this op at genomics shapes
 (N≈2.5k, V up to millions).
 
-Opt-in via ``SPARK_EXAMPLES_TPU_PALLAS=1`` (or ``use_pallas=True`` in
-:func:`spark_examples_tpu.ops.gramian_blockwise`) until profiled as the
-default on real hardware; numerics are exact (f32 accumulation of 0/1
-products) and tested against the einsum path in interpret mode.
+Opt-in via ``SPARK_EXAMPLES_TPU_PALLAS=dense`` (this kernel) or ``=sym``
+(the triangle-only variant — ~2× fewer MXU tile matmuls, mirror deferred
+to end of stream; unknown values raise) — or ``use_pallas=True`` on
+:func:`spark_examples_tpu.ops.gramian_blockwise` — until profiled as the
+default on real hardware (``scripts/tpu_microbench.py``); numerics are
+exact (f32 accumulation of 0/1 products) and tested against the einsum
+path in interpret mode.
 """
 
 from __future__ import annotations
