@@ -213,25 +213,41 @@ class VariantsPcaDriver:
         assert len(self.conf.variant_set_ids) == 1, (
             "checkpointed ingest supports a single variantset"
         )
-        if jax.process_count() > 1:
+        if self._mesh_spans_processes():
             raise NotImplementedError(
-                "checkpointed ingest is single-host for now: hosts would "
-                "race on one snapshot file; use per-host checkpoint dirs "
-                "in a future revision"
+                "checkpointed ingest composes with host-local meshes and "
+                "DP across hosts, not the global-mesh (pod) path: pod "
+                "blocks are collective per step, so a per-host cursor "
+                "cannot resume them independently"
             )
         vsid = self.conf.variant_set_ids[0]
-        shards = self.conf.shards(
-            all_references=self.conf.all_references,
-            sex_filter=SexChromosomeFilter.EXCLUDE_XY,
+        shards = self._host_shards(
+            self.conf.shards(
+                all_references=self.conf.all_references,
+                sex_filter=SexChromosomeFilter.EXCLUDE_XY,
+            )
         )
-        # The snapshot key covers everything that determines G's content:
-        # the shard manifest, the dataset, and the AF filter.
+        checkpoint_dir = self.conf.checkpoint_dir
+        # Multi-host: each process checkpoints ITS manifest slice into its
+        # own subdirectory (no cross-host file races); partials merge
+        # after all hosts complete, exactly as in the uncheckpointed path.
+        # The slice depends on the process grid, so the digest pins it.
+        host_tag = ""
+        if jax.process_count() > 1:
+            host_tag = (
+                f"|host={jax.process_index()}/{jax.process_count()}"
+            )
+            checkpoint_dir = os.path.join(
+                checkpoint_dir, f"host-{jax.process_index()}"
+            )
+        # The snapshot key covers everything that determines this host's
+        # partial G: the manifest slice, dataset, AF filter, process grid.
         digest = (
             f"{manifest_digest(shards)}|{vsid}"
-            f"|af={self.conf.min_allele_frequency}"
+            f"|af={self.conf.min_allele_frequency}{host_tag}"
         )
         n = self.index.size
-        ck = load_snapshot(self.conf.checkpoint_dir, digest, n)
+        ck = load_snapshot(checkpoint_dir, digest, n)
         done = ck.shards_done if ck else 0
         if ck:
             print(f"Resuming from snapshot: {done}/{len(shards)} shards done.")
@@ -253,12 +269,16 @@ class VariantsPcaDriver:
             )
             g = self._blocks_to_gramian(blocks, g_init=g)
             done += len(group)
-            save_snapshot(self.conf.checkpoint_dir, g, done, digest)
-        return (
-            g
-            if g is not None
-            else self._blocks_to_gramian(iter(()))
-        )
+            save_snapshot(checkpoint_dir, g, done, digest)
+        if g is None:
+            g = self._blocks_to_gramian(iter(()))
+        if jax.process_count() > 1:
+            from spark_examples_tpu.parallel.distributed import (
+                allreduce_gramian,
+            )
+
+            g = allreduce_gramian(jax.numpy.asarray(g))
+        return g
 
     # -- stage 5: eigendecomposition ----------------------------------------
 
