@@ -1,0 +1,74 @@
+"""Flag-surface tests: GenomicsConf/PcaConf parity wiring."""
+
+import argparse
+
+from spark_examples_tpu.utils.config import (
+    PLATINUM_GENOMES,
+    PcaConfig,
+    add_pca_flags,
+    pca_config_from_args,
+)
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser()
+    add_pca_flags(p)
+    return pca_config_from_args(p.parse_args(argv))
+
+
+def test_defaults_match_reference():
+    conf = _parse([])
+    assert conf.bases_per_partition == 1_000_000  # GenomicsConf.scala:32
+    assert conf.references == "17:41196311:41277499"  # BRCA1 default
+    assert conf.variant_set_ids == [PLATINUM_GENOMES]
+    assert conf.num_pc == 2  # GenomicsConf.scala:85
+    assert conf.min_allele_frequency is None
+    assert not conf.all_references
+
+
+def test_repeated_variant_set_id():
+    conf = _parse(["--variant-set-id", "a", "--variant-set-id", "b"])
+    assert conf.variant_set_ids == ["a", "b"]
+
+
+def test_pca_extras():
+    conf = _parse(
+        [
+            "--all-references",
+            "--min-allele-frequency",
+            "0.05",
+            "--num-pc",
+            "4",
+            "--precise",
+            "--checkpoint-dir",
+            "/tmp/x",
+            "--trace-dir",
+            "/tmp/t",
+        ]
+    )
+    assert conf.all_references and conf.precise
+    assert conf.min_allele_frequency == 0.05
+    assert conf.num_pc == 4
+    assert conf.checkpoint_dir == "/tmp/x" and conf.trace_dir == "/tmp/t"
+
+
+def test_shards_partitioner_selection():
+    conf = PcaConfig(bases_per_partition=50_000_000)
+    brca1 = conf.shards(all_references=False)
+    assert len(brca1) == 1 and brca1[0].contig == "17"
+    all_auto = conf.shards(all_references=True)
+    assert {s.contig for s in all_auto} == {str(i) for i in range(1, 23)}
+
+
+def test_stage_timer_report():
+    import time
+
+    from spark_examples_tpu.utils.tracing import StageTimer
+
+    t = StageTimer()
+    with t.stage("a"):
+        time.sleep(0.01)
+    with t.stage("b"):
+        pass
+    rep = t.report()
+    assert "a:" in rep and "b:" in rep and "total:" in rep
