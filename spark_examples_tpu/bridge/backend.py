@@ -49,6 +49,11 @@ class TpuPcaBackend:
     def compute(
         self, calls: Iterable[Sequence[int]], n_samples: int, num_pc: int
     ):
+        if num_pc < 1 or n_samples < 1:
+            raise ValueError(
+                f"need n_samples >= 1 and num_pc >= 1, got "
+                f"n_samples={n_samples}, num_pc={num_pc}"
+            )
         from spark_examples_tpu.arrays.blocks import blocks_from_calls
         from spark_examples_tpu.ops import gramian_blockwise, pcoa
 
@@ -84,9 +89,15 @@ class _Handler(socketserver.StreamRequestHandler):
                 if n_samples is None:
                     self._reply({"error": "finish before init"})
                     return
-                coords, eigvals = backend.compute(
-                    iter(batches), n_samples, num_pc
-                )
+                try:
+                    coords, eigvals = backend.compute(
+                        iter(batches), n_samples, num_pc
+                    )
+                except (ValueError, KeyError) as e:
+                    # Validation failures travel back to the client
+                    # instead of silently dropping the connection.
+                    self._reply({"error": str(e)})
+                    return
                 self._reply(
                     {
                         "coords": np.asarray(coords).tolist(),

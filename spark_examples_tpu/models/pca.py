@@ -48,6 +48,10 @@ __all__ = ["VariantsPcaDriver"]
 
 class VariantsPcaDriver:
     def __init__(self, conf: PcaConfig, source, mesh=None):
+        if conf.num_pc < 1:
+            # Validate before any ingest work — failing in stage 5 would
+            # waste the whole (potentially hours-long) Gramian pass.
+            raise ValueError(f"--num-pc must be >= 1, got {conf.num_pc}")
         self.conf = conf
         self.source = source
         self.mesh = mesh

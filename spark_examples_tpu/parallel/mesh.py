@@ -30,9 +30,20 @@ def make_mesh(
         return Mesh(np.array(devices), (DATA_AXIS,))
     names, sizes = [], []
     for part in spec.split(","):
-        name, size = part.strip().split(":")
+        try:
+            name, size = part.strip().split(":")
+            sizes.append(int(size))
+        except ValueError:
+            raise ValueError(
+                f"bad mesh spec segment {part!r} in {spec!r}: expected "
+                "'axis:size[,axis:size...]', e.g. 'data:4,model:2'"
+            ) from None
+        if sizes[-1] < 1:
+            raise ValueError(
+                f"mesh axis {name!r} has non-positive size {sizes[-1]} "
+                f"in {spec!r}"
+            )
         names.append(name)
-        sizes.append(int(size))
     want = int(np.prod(sizes))
     if want > len(devices):
         raise ValueError(

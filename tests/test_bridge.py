@@ -61,3 +61,16 @@ def test_bridge_error_on_missing_init():
         sock.close()
     finally:
         server.stop()
+
+
+def test_bridge_invalid_num_pc_reported():
+    server = PcaBridgeServer(TpuPcaBackend()).start()
+    try:
+        client = PcaBridgeClient(port=server.port)
+        import pytest
+
+        with pytest.raises(RuntimeError, match="num_pc"):
+            client.compute([[0]], 3, 0)
+        client.close()
+    finally:
+        server.stop()
