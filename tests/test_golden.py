@@ -1,0 +1,52 @@
+"""Committed golden end-to-end output — cross-round numeric drift anchor.
+
+SURVEY.md §4 calls for a BRCA1-sized golden fixture reproducing the
+emitResult output. The golden TSV was produced by the full pipeline
+(fixture seed 0, 64 samples × 500 variants, ``--precise`` host-f64 path)
+and committed; any change that shifts principal coordinates beyond 1e-6
+against it is either a deliberate semantic change (regenerate the golden
+and say so in the commit) or a regression.
+"""
+
+import os
+
+import numpy as np
+
+from spark_examples_tpu.genomics.fixtures import (
+    DEFAULT_VARIANT_SET_ID,
+    synthetic_cohort,
+)
+from spark_examples_tpu.models.pca import VariantsPcaDriver
+from spark_examples_tpu.utils.config import PcaConfig
+
+GOLDEN = os.path.join(
+    os.path.dirname(__file__), "golden", "brca1_cohort64_seed0-pca.tsv"
+)
+
+
+def _load(path):
+    rows = {}
+    with open(path) as f:
+        for line in f:
+            name, pc1, pc2, dataset = line.rstrip("\n").split("\t")
+            rows[name] = (float(pc1), float(pc2), dataset)
+    return rows
+
+
+def test_pipeline_matches_committed_golden(tmp_path):
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        output_path=str(tmp_path / "out"),
+        precise=True,
+        block_variants=64,
+    )
+    VariantsPcaDriver(conf, synthetic_cohort(64, 500, seed=0)).run()
+
+    got = _load(str(tmp_path / "out-pca.tsv"))
+    want = _load(GOLDEN)
+    assert got.keys() == want.keys()
+    for name in want:
+        np.testing.assert_allclose(
+            got[name][:2], want[name][:2], atol=1e-6, err_msg=name
+        )
+        assert got[name][2] == want[name][2]
