@@ -43,6 +43,32 @@ def _log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def _backend_guard():
+    """Fail over to CPU when the axon TPU relay is dead.
+
+    The relay can die mid-session (NOTES.md hardware incidents); without
+    this guard the first device op blocks forever and the round records no
+    benchmark at all. A CPU number with a loud stderr warning beats a
+    hang — the metric is rate-normalized either way.
+    """
+    if os.environ.get("JAX_PLATFORMS", "") != "axon":
+        return False
+    import socket
+
+    try:
+        socket.create_connection(("127.0.0.1", 8093), timeout=5).close()
+        return False
+    except OSError:
+        _log(
+            "bench: WARNING — axon relay unreachable; falling back to CPU. "
+            "These are NOT TPU numbers."
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return True
+
+
 def make_blocks(seed=0):
     rng = np.random.default_rng(seed)
     return [
@@ -51,7 +77,7 @@ def make_blocks(seed=0):
     ]
 
 
-def tpu_time(blocks):
+def tpu_time(blocks, cpu_fallback=False):
     import jax
     import jax.numpy as jnp
 
@@ -76,6 +102,10 @@ def tpu_time(blocks):
         modes = {"int8": modes["int8"]} if forced == "1" else {
             "f32": modes["f32"]
         }
+    elif cpu_fallback:
+        # Degraded mode: measure one path only (int8 wins consistently on
+        # CPU) — keeps the fallback well under any harness timeout.
+        modes = {"int8": modes["int8"]}
 
     best = None
     for name, dt in modes.items():
@@ -122,16 +152,19 @@ def cpu_reference_time(blocks):
 
 
 def main():
+    fallback = _backend_guard()
     blocks = make_blocks()
     # The axon remote-compile tunnel occasionally drops a request
     # (transient INTERNAL "response body closed"); one retry covers it.
     try:
-        t_tpu, coords_tpu = tpu_time(blocks)
+        t_tpu, coords_tpu = tpu_time(blocks, cpu_fallback=fallback)
     except Exception as e:  # noqa: BLE001 — retry once, then fail for real
         _log(f"bench: first attempt failed ({type(e).__name__}: {e}); retrying")
         time.sleep(10)
-        t_tpu, coords_tpu = tpu_time(blocks)
+        t_tpu, coords_tpu = tpu_time(blocks, cpu_fallback=fallback)
     t_cpu, _ = cpu_reference_time(blocks)
+
+    import jax
 
     value = N_SAMPLES * N_SAMPLES * N_VARIANTS / t_tpu
     print(
@@ -141,6 +174,11 @@ def main():
                 "value": value,
                 "unit": "samples^2*variants/s",
                 "vs_baseline": t_cpu / t_tpu,
+                # Machine-readable provenance: a relay-dead CPU-fallback
+                # number must never be mistaken for a TPU measurement.
+                "backend": (
+                    "cpu-fallback" if fallback else jax.default_backend()
+                ),
             }
         )
     )
