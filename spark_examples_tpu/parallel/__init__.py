@@ -20,6 +20,7 @@ from spark_examples_tpu.parallel.mesh import make_mesh, DATA_AXIS, MODEL_AXIS
 from spark_examples_tpu.parallel.sharded import (
     gramian_blockwise_global,
     gramian_variant_parallel,
+    gramian_variant_parallel_ring,
     sharded_gramian_blockwise,
     sharded_pcoa,
     topk_eig_randomized,
@@ -36,6 +37,7 @@ __all__ = [
     "MODEL_AXIS",
     "gramian_blockwise_global",
     "gramian_variant_parallel",
+    "gramian_variant_parallel_ring",
     "sharded_gramian_blockwise",
     "sharded_pcoa",
     "topk_eig_randomized",

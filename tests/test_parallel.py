@@ -202,3 +202,18 @@ def test_cli_pca_with_mesh_flag(capsys, tmp_path):
     assert rc == 0
     assert "Matrix size: 13" in capsys.readouterr().out
     assert (tmp_path / "mesh-pca.tsv").exists()
+
+
+def test_ring_reduction_matches_psum(x_small=None):
+    from spark_examples_tpu.parallel import (
+        gramian_variant_parallel,
+        gramian_variant_parallel_ring,
+    )
+
+    rng = np.random.default_rng(21)
+    x = (rng.random((16, 256)) < 0.3).astype(np.int8)
+    mesh = make_mesh("data:8")
+    ring = np.asarray(gramian_variant_parallel_ring(jnp.asarray(x), mesh))
+    psum = np.asarray(gramian_variant_parallel(jnp.asarray(x), mesh))
+    np.testing.assert_array_equal(ring, psum)
+    np.testing.assert_array_equal(ring, np.asarray(gramian(x)))
