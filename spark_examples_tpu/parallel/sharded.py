@@ -159,7 +159,15 @@ def gramian_variant_parallel_ring(x, mesh: Mesh, compute_dtype=jnp.float32):
             return acc + buf, buf
 
         acc, _ = jax.lax.fori_loop(0, n_dev - 1, body, (g_loc, g_loc))
-        return acc
+        # Each device accumulated the same partials in a rotated order;
+        # float non-associativity would make the "replicated" shards
+        # bitwise-divergent (exact for 0/1 inputs, not for dosage-valued
+        # X). Canonicalize by broadcasting device 0's copy so every shard
+        # is identical regardless of input values.
+        idx = jax.lax.axis_index(DATA_AXIS)
+        return jax.lax.psum(
+            jnp.where(idx == 0, acc, jnp.zeros_like(acc)), DATA_AXIS
+        )
 
     return jax.jit(_ring)(x)
 

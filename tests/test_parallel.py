@@ -204,11 +204,8 @@ def test_cli_pca_with_mesh_flag(capsys, tmp_path):
     assert (tmp_path / "mesh-pca.tsv").exists()
 
 
-def test_ring_reduction_matches_psum(x_small=None):
-    from spark_examples_tpu.parallel import (
-        gramian_variant_parallel,
-        gramian_variant_parallel_ring,
-    )
+def test_ring_reduction_matches_psum():
+    from spark_examples_tpu.parallel import gramian_variant_parallel_ring
 
     rng = np.random.default_rng(21)
     x = (rng.random((16, 256)) < 0.3).astype(np.int8)
@@ -217,3 +214,10 @@ def test_ring_reduction_matches_psum(x_small=None):
     psum = np.asarray(gramian_variant_parallel(jnp.asarray(x), mesh))
     np.testing.assert_array_equal(ring, psum)
     np.testing.assert_array_equal(ring, np.asarray(gramian(x)))
+
+    # Float-valued X (dosages): replicas must still be bitwise canonical.
+    xf = rng.random((16, 256)).astype(np.float32)
+    ringf = gramian_variant_parallel_ring(jnp.asarray(xf), mesh)
+    shards = [np.asarray(s.data) for s in ringf.addressable_shards]
+    for sh in shards[1:]:
+        np.testing.assert_array_equal(shards[0], sh)
