@@ -74,3 +74,37 @@ def test_bridge_invalid_num_pc_reported():
         client.close()
     finally:
         server.stop()
+
+
+def test_external_driver_example_script(tmp_path):
+    """The examples/ client script runs end-to-end against a live server."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    server = PcaBridgeServer(TpuPcaBackend(block_variants=64)).start()
+    try:
+        out = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(root, "examples", "external_driver_pca.py"),
+                "--port",
+                str(server.port),
+                "--samples",
+                "8",
+                "--variants",
+                "40",
+            ],
+            env={**os.environ, "PYTHONPATH": root, "JAX_PLATFORMS": "cpu"},
+            capture_output=True,
+            timeout=120,
+            text=True,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        lines = [
+            l for l in out.stdout.strip().split("\n") if "\t" in l
+        ]
+        assert len(lines) == 8  # one coordinate row per sample
+    finally:
+        server.stop()

@@ -200,3 +200,25 @@ class TestChrPrefixSymmetry:
         )
         (v,) = src.stream_variants("", Shard("chr17", 0, 10))
         assert v.start == 5
+
+
+class TestGzipCohort:
+    def test_gzipped_jsonl_read(self, tmp_path):
+        import gzip
+        import json
+        import shutil
+
+        src = synthetic_cohort(5, 15)
+        src.dump(str(tmp_path))
+        # Compress variants.jsonl -> variants.jsonl.gz and remove the plain
+        # file; JsonlSource must transparently read the gz.
+        plain = tmp_path / "variants.jsonl"
+        with open(plain, "rb") as fin, gzip.open(
+            str(plain) + ".gz", "wb"
+        ) as fout:
+            shutil.copyfileobj(fin, fout)
+        plain.unlink()
+
+        jsrc = JsonlSource(str(tmp_path))
+        shard = Shard("17", 41196311, 41277499)
+        assert len(list(jsrc.stream_variants("", shard))) == 15
