@@ -51,7 +51,14 @@ def _backend_guard():
     benchmark at all. A CPU number with a loud stderr warning beats a
     hang — the metric is rate-normalized either way.
     """
-    if os.environ.get("JAX_PLATFORMS", "") != "axon":
+    # The axon sitecustomize bakes the platform in before user code runs,
+    # so JAX_PLATFORMS alone is not a reliable signal — engage whenever
+    # the axon site could steer this process (and never on machines
+    # without it, which keep their native backends).
+    axon_possible = os.path.isdir("/root/.axon_site") or (
+        os.environ.get("JAX_PLATFORMS", "") == "axon"
+    )
+    if not axon_possible or os.environ.get("JAX_PLATFORMS", "") == "cpu":
         return False
     import socket
 
@@ -122,7 +129,7 @@ def tpu_time(blocks, cpu_fallback=False):
         if best is None or dt_s < best[0]:
             best = (dt_s, np.asarray(coords), name)
     _log(f"bench: using {best[2]} path")
-    return best[0], best[1]
+    return best[0], best[1], sorted(modes), best[2]
 
 
 def cpu_reference_time(blocks):
@@ -157,11 +164,15 @@ def main():
     # The axon remote-compile tunnel occasionally drops a request
     # (transient INTERNAL "response body closed"); one retry covers it.
     try:
-        t_tpu, coords_tpu = tpu_time(blocks, cpu_fallback=fallback)
+        t_tpu, coords_tpu, modes_measured, mode_used = tpu_time(
+            blocks, cpu_fallback=fallback
+        )
     except Exception as e:  # noqa: BLE001 — retry once, then fail for real
         _log(f"bench: first attempt failed ({type(e).__name__}: {e}); retrying")
         time.sleep(10)
-        t_tpu, coords_tpu = tpu_time(blocks, cpu_fallback=fallback)
+        t_tpu, coords_tpu, modes_measured, mode_used = tpu_time(
+            blocks, cpu_fallback=fallback
+        )
     t_cpu, _ = cpu_reference_time(blocks)
 
     import jax
@@ -175,10 +186,18 @@ def main():
                 "unit": "samples^2*variants/s",
                 "vs_baseline": t_cpu / t_tpu,
                 # Machine-readable provenance: a relay-dead CPU-fallback
-                # number must never be mistaken for a TPU measurement.
+                # number must never be mistaken for a TPU measurement, a
+                # single-mode degraded run for a full sweep, or the
+                # slice-scaled baseline for a fully-measured one.
                 "backend": (
                     "cpu-fallback" if fallback else jax.default_backend()
                 ),
+                "modes_measured": modes_measured,
+                "mode_used": mode_used,
+                "workload": {"samples": N_SAMPLES, "variants": N_VARIANTS},
+                "baseline_accum": "slice-scaled (1 block, 1/16 of its "
+                "columns, scaled linearly to V)",
+                "baseline_eig": "measured in full (f64 LAPACK)",
             }
         )
     )

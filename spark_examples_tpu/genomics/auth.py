@@ -58,9 +58,9 @@ def get_access_token(
     """Resolve credentials — Authentication.getAccessToken semantics.
 
     Args:
-      client_secrets_path: path to a JSON file with a ``token`` (or
-        ``client_id``/``client_secret``) entry; triggers the visibility
-        warning + confirmation.
+      client_secrets_path: path to a JSON file with an explicit ``token``
+        entry (client_id-only files are rejected — no OAuth exchange flow
+        exists here); triggers the visibility warning + confirmation.
       interactive: force/deny the confirmation prompt; default = stdin is
         a TTY. (Deliberately never queries jax: multi-host worker
         processes have no TTY, so they fail closed; touching
@@ -77,10 +77,16 @@ def get_access_token(
             raise AuthError(
                 f"cannot read client secrets {client_secrets_path}: {e}"
             ) from e
-        token = secrets.get("token") or secrets.get("client_id")
+        # Only an explicit 'token' authenticates: a client_id is public
+        # identity, not a secret, and treating it as a credential would
+        # hand the confirmed-visible "credential" zero actual access
+        # (the reference runs a full OAuth user flow here).
+        token = secrets.get("token")
         if not token:
             raise AuthError(
-                f"{client_secrets_path} has neither 'token' nor 'client_id'"
+                f"{client_secrets_path} has no 'token' entry; client_id-only "
+                "secrets files are unsupported (no OAuth flow in this "
+                "framework — pre-exchange the token)"
             )
         if interactive is None:
             interactive = sys.stdin.isatty()

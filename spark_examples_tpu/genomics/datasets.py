@@ -74,56 +74,153 @@ def _keyed(stream, chunk: int = 65536):
         yield from zip(variant_identities(block), block)
 
 
+def _flatten_runs(runs):
+    for _, group in runs:
+        yield from group
+
+
+def _aligned_chunks(
+    streams: Sequence[Iterable[Variant]],
+) -> Iterator[List[Iterable[Variant]]]:
+    """Align the streams into per-contig chunks for bounded-memory joins.
+
+    The variant identity hash embeds the contig, so records on different
+    contigs can never join — partitioning any identity join/merge by contig
+    is semantically lossless, and it bounds the join state to one contig's
+    variants instead of a whole cohort's (~40M+ at all-autosomes
+    multi-dataset scale, where the reference shuffled across a cluster,
+    VariantsPca.scala:136-148).
+
+    PRECONDITION (the caller's promise, see ``contig_runs_unique``): each
+    stream presents each contig as AT MOST ONE contiguous run. Identities
+    in two different runs of the same contig would never meet; the seen-set
+    below turns that silent wrongness into a loud error. Manifest-driven
+    streams satisfy the precondition whenever the manifest visits each
+    contig once (checked by the pipeline, not assumed).
+
+    When run orders diverge — e.g. one dataset has no variants on some
+    contig — the remainder of every stream is yielded as a single final
+    chunk: unbounded again, but never wrong.
+
+    Each yielded chunk must be fully consumed before the next is requested
+    (itertools.groupby invalidates prior groups on advance); the consumers
+    below do exactly that.
+    """
+    runs = [
+        itertools.groupby(s, key=lambda v: v.contig) for s in streams
+    ]
+    seen = set()
+    while True:
+        heads = []
+        for r in runs:
+            try:
+                heads.append(next(r))
+            except StopIteration:
+                heads.append(None)
+        if all(h is None for h in heads):
+            return
+        contigs = {h[0] for h in heads if h is not None}
+        if contigs & seen:
+            raise ValueError(
+                f"contig(s) {sorted(contigs & seen)} appear in more than "
+                "one run of a stream; contig-partitioned joins need "
+                "unique contig runs (pass contig_runs_unique=False)"
+            )
+        if len(contigs) == 1 and all(h is not None for h in heads):
+            seen.update(contigs)
+            yield [h[1] for h in heads]
+        else:
+            yield [
+                itertools.chain(
+                    h[1] if h is not None else (), _flatten_runs(r)
+                )
+                for h, r in zip(heads, runs)
+            ]
+            return
+
+
 def join_datasets(
-    a: Iterable[Variant], b: Iterable[Variant], indexes: Dict[str, int]
+    a: Iterable[Variant],
+    b: Iterable[Variant],
+    indexes: Dict[str, int],
+    contig_runs_unique: bool = False,
 ) -> Iterator[List[int]]:
     """Two-dataset inner join on variant identity (VariantsPca.scala:115-128).
 
     Yields concatenated carrying-sample index lists for variants present in
-    both datasets.
+    both datasets — one row per matching (left record, right record) pair,
+    exactly as the reference's RDD join does when an identity occurs more
+    than once within a dataset.
+
+    ``contig_runs_unique=True`` is the caller's promise that each stream
+    presents each contig as at most one contiguous run (true for
+    manifest-driven streams whose manifest visits each contig once); under
+    it, join state is bounded per contig via :func:`_aligned_chunks`
+    instead of growing with the whole cohort.
     """
-    left: Dict[str, List[int]] = {}
-    for key, v in _keyed(a):
-        left[key] = carrying_sample_indices(v, indexes)
-    for key, v in _keyed(b):
-        if key in left:
-            yield left[key] + carrying_sample_indices(v, indexes)
+    chunk_pairs = (
+        _aligned_chunks([a, b]) if contig_runs_unique else iter([[a, b]])
+    )
+    for chunk_a, chunk_b in chunk_pairs:
+        left: Dict[str, List[List[int]]] = {}
+        for key, v in _keyed(chunk_a):
+            left.setdefault(key, []).append(
+                carrying_sample_indices(v, indexes)
+            )
+        for key, v in _keyed(chunk_b):
+            rows = left.get(key)
+            if rows is not None:
+                right = carrying_sample_indices(v, indexes)
+                for left_calls in rows:
+                    yield left_calls + right
 
 
 def merge_datasets(
-    streams: Sequence[Iterable[Variant]], indexes: Dict[str, int]
+    streams: Sequence[Iterable[Variant]],
+    indexes: Dict[str, int],
+    contig_runs_unique: bool = False,
 ) -> Iterator[List[int]]:
     """N-way merge keeping variants present in *all* datasets.
 
     The reference unions all sets, groups by identity, and keeps groups of
     size == dataset count (VariantsPca.scala:136-148) — record count, not
-    distinct-set count, replicated here.
+    distinct-set count, replicated here. Group state is bounded per contig
+    via :func:`_aligned_chunks` under the ``contig_runs_unique`` promise
+    (see :func:`join_datasets`).
     """
-    groups: Dict[str, List[int]] = {}
-    counts: Dict[str, int] = {}
-    for stream in streams:
-        for key, v in _keyed(stream):
-            counts[key] = counts.get(key, 0) + 1
-            groups.setdefault(key, []).extend(
-                carrying_sample_indices(v, indexes)
-            )
     want = len(streams)
-    for key, calls in groups.items():
-        if counts[key] == want:
-            yield calls
+    chunk_sets = (
+        _aligned_chunks(streams) if contig_runs_unique else iter([streams])
+    )
+    for chunks in chunk_sets:
+        groups: Dict[str, List[int]] = {}
+        counts: Dict[str, int] = {}
+        for chunk in chunks:
+            for key, v in _keyed(chunk):
+                counts[key] = counts.get(key, 0) + 1
+                groups.setdefault(key, []).extend(
+                    carrying_sample_indices(v, indexes)
+                )
+        for key, calls in groups.items():
+            if counts[key] == want:
+                yield calls
 
 
 def calls_stream(
-    streams: Sequence[Iterable[Variant]], indexes: Dict[str, int]
+    streams: Sequence[Iterable[Variant]],
+    indexes: Dict[str, int],
+    contig_runs_unique: bool = False,
 ) -> Iterator[List[int]]:
     """Dispatch 1/2/N datasets → per-variant index lists, dropping variants
     with no carrying samples (getCallsRdd, VariantsPca.scala:153-168)."""
     if len(streams) == 1:
         gen = (carrying_sample_indices(v, indexes) for v in streams[0])
     elif len(streams) == 2:
-        gen = join_datasets(streams[0], streams[1], indexes)
+        gen = join_datasets(
+            streams[0], streams[1], indexes, contig_runs_unique
+        )
     else:
-        gen = merge_datasets(streams, indexes)
+        gen = merge_datasets(streams, indexes, contig_runs_unique)
     for calls in gen:
         if calls:
             yield calls

@@ -46,6 +46,20 @@ from spark_examples_tpu.utils.config import PcaConfig
 __all__ = ["VariantsPcaDriver"]
 
 
+def _contig_runs_unique(shards) -> bool:
+    """True when the manifest presents each contig as one contiguous run —
+    the precondition for contig-partitioned (bounded-memory) joins."""
+    seen = set()
+    last = None
+    for s in shards:
+        if s.contig != last:
+            if s.contig in seen:
+                return False
+            seen.add(s.contig)
+            last = s.contig
+    return True
+
+
 class VariantsPcaDriver:
     def __init__(self, conf: PcaConfig, source, mesh=None):
         if conf.num_pc < 1:
@@ -73,6 +87,11 @@ class VariantsPcaDriver:
                 sex_filter=SexChromosomeFilter.EXCLUDE_XY,
             )
         )
+        # When the manifest visits each contig exactly once (one contiguous
+        # run — true for --all-references and any non-repeating
+        # --references), multi-dataset joins may partition their state by
+        # contig instead of holding the whole cohort's identities.
+        self._contig_runs_unique = _contig_runs_unique(shards)
 
         def stream(vsid: str) -> Iterator[Variant]:
             for shard in shards:
@@ -103,7 +122,11 @@ class VariantsPcaDriver:
         interface at VariantsPca.scala:153-168)."""
         if self.conf.debug_datasets:
             streams = [self._debug_wrap(s) for s in streams]
-        return calls_stream(list(streams), self.index.indexes)
+        return calls_stream(
+            list(streams),
+            self.index.indexes,
+            contig_runs_unique=getattr(self, "_contig_runs_unique", False),
+        )
 
     @staticmethod
     def _debug_wrap(stream):

@@ -110,15 +110,21 @@ def average_coverage(
     bases_per_shard: int = DEFAULT_BASES_PER_SHARD,
     length: Optional[int] = None,
 ) -> float:
-    """Σ aligned-sequence length / region length
-    (SearchReadsExample2, :115-133; default region = whole chr21)."""
+    """Σ aligned-sequence length / (end − start) of the region
+    (SearchReadsExample2, :115-133; default region = whole chr21, where
+    the divisor equals the reference's chromosome length)."""
     if references:
         contig, start, end = _single_region(references)
-        denom = end - start  # explicit region → per-base of that region
     else:
-        start, end = 1, length or HUMAN_CHROMOSOMES[contig]
+        start, end = 0, length or HUMAN_CHROMOSOMES[contig]
         references = f"{contig}:{start}:{end}"
-        denom = end  # reference behavior: divide by chromosome length
+    # One denominator convention regardless of how the region was given:
+    # the half-open region's length, end - start. The reference divides by
+    # the chromosome length (SearchReadsExample2:129) and only ever runs on
+    # the whole chromosome; the default region here is 0:length, so the
+    # default path reproduces its divisor exactly and passing that region
+    # explicitly yields the identical result.
+    denom = end - start
     total = 0
     for _, reads in _stream(
         source, read_group_set_id, references, bases_per_shard
@@ -265,22 +271,35 @@ def _freq_strings(
     def compute(shard, reads, pad):
         window = shard.range + round_up_multiple(pad, 128)
         reads = [r for r in reads if r.mapping_quality >= min_mapping_qual]
-        if not reads:
+        # Reads longer than the scatter-row width become several rows with
+        # shifted starts, so every aligned base is counted — the reference
+        # counts all of them (SearchReadsExample.scala:224-229); capping
+        # bounds the dense row width for the kernel, never the data.
+        segs = []
+        for r in reads:
+            seq, qual = r.aligned_sequence, r.aligned_quality
+            for off in range(0, len(seq), read_len_cap) or (0,):
+                segs.append(
+                    (
+                        r.position - shard.start + off,
+                        seq[off : off + read_len_cap],
+                        qual[off : off + read_len_cap],
+                    )
+                )
+        if not segs:
             return np.zeros((window, 5), np.int64)
-        n_pad = _pad_pow2(len(reads))
+        n_pad = _pad_pow2(len(segs))
         max_len = _pad_pow2(
-            min(read_len_cap, max(len(r.aligned_sequence) for r in reads)),
-            floor=64,
+            max(len(s) for _, s, _ in segs) or 1, floor=64
         )
         starts = np.zeros(n_pad, np.int32)
         codes = np.full((n_pad, max_len), -1, np.int8)
         quals = np.full((n_pad, max_len), -1, np.int32)
-        for j, r in enumerate(reads):
-            starts[j] = r.position - shard.start
-            l = min(len(r.aligned_sequence), max_len)
-            codes[j, :l] = encode_bases(r.aligned_sequence[:l])
-            lq = min(len(r.aligned_quality), l)
-            quals[j, :lq] = r.aligned_quality[:lq]
+        for j, (seg_start, seq, qual) in enumerate(segs):
+            starts[j] = seg_start
+            codes[j, : len(seq)] = encode_bases(seq)
+            lq = min(len(qual), len(seq))
+            quals[j, :lq] = qual[:lq]
         return np.asarray(
             base_frequency_table(starts, codes, quals, min_base_qual, window),
             dtype=np.int64,

@@ -29,6 +29,16 @@ class TestClientSecrets:
         )
         assert creds.source == "client-secrets"
 
+    def test_client_id_only_secrets_rejected(self, tmp_path):
+        # A client_id is public identity, not a credential; silently using
+        # it as a token would produce a confirmed-but-useless credential.
+        f = tmp_path / "secrets.json"
+        f.write_text(json.dumps({"client_id": "abc.apps.example"}))
+        with pytest.raises(AuthError, match="no 'token'"):
+            get_access_token(
+                str(f), interactive=True, _input=lambda prompt: "y"
+            )
+
     def test_interactive_decline_raises(self, tmp_path):
         f = tmp_path / "secrets.json"
         f.write_text(json.dumps({"token": "t"}))

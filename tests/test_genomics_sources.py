@@ -156,6 +156,91 @@ class TestDatasets:
         assert sorted(out[0]) == [0, 1, 2]
         assert len(out) == 1  # position 2 present in only one set
 
+    def test_join_duplicate_identities_cross_product(self):
+        # Duplicate identities within a dataset join like the reference's
+        # RDD join: one output row per (left record, right record) pair.
+        idx = {"a": 0, "b": 1, "c": 2, "d": 3}
+        set1 = [
+            _variant("17", 1, calls=[_call("a", (0, 1))]),
+            _variant("17", 1, calls=[_call("b", (1, 1))]),
+        ]
+        set2 = [
+            _variant("17", 1, calls=[_call("c", (0, 1))]),
+            _variant("17", 1, calls=[_call("d", (1, 1))]),
+        ]
+        out = sorted(join_datasets(set1, set2, idx))
+        assert out == [[0, 2], [0, 3], [1, 2], [1, 3]]
+
+    def test_join_multi_contig_aligned_runs(self):
+        idx = {"a": 0, "b": 1}
+        s1 = [
+            _variant(c, p, calls=[_call("a", (0, 1))])
+            for c, p in [("1", 5), ("2", 7), ("17", 9)]
+        ]
+        s2 = [
+            _variant(c, p, calls=[_call("b", (1, 1))])
+            for c, p in [("1", 5), ("2", 8), ("17", 9)]
+        ]
+        # Contigs 1 and 17 share positions; contig 2 differs.
+        assert list(
+            join_datasets(s1, s2, idx, contig_runs_unique=True)
+        ) == [[0, 1], [0, 1]]
+
+    def test_join_divergent_run_order_still_correct(self):
+        # Contig runs arriving in different orders fall back to the
+        # unbounded path — results must be identical, nothing dropped.
+        idx = {"a": 0, "b": 1}
+        s1 = [
+            _variant("1", 5, calls=[_call("a", (0, 1))]),
+            _variant("2", 7, calls=[_call("a", (1, 1))]),
+        ]
+        s2 = [
+            _variant("2", 7, calls=[_call("b", (0, 1))]),
+            _variant("1", 5, calls=[_call("b", (1, 1))]),
+        ]
+        assert sorted(
+            join_datasets(s1, s2, idx, contig_runs_unique=True)
+        ) == [[0, 1], [0, 1]]
+
+    def test_aligned_chunks_bounded_per_contig(self):
+        from spark_examples_tpu.genomics.datasets import _aligned_chunks
+
+        def mk(contigs, pos):
+            return [_variant(c, pos) for c in contigs]
+
+        # Aligned: one chunk per contig — join state is bounded by the
+        # largest contig, not the cohort.
+        chunks = [
+            [list(part) for part in chunk]
+            for chunk in _aligned_chunks([mk("123", 1), mk("123", 2)])
+        ]
+        assert len(chunks) == 3
+
+        # A contig missing from one stream: lossless remainder fallback.
+        chunks = [
+            [list(part) for part in chunk]
+            for chunk in _aligned_chunks([mk("123", 1), mk("13", 3)])
+        ]
+        assert len(chunks) == 2
+        assert [v.contig for v in chunks[1][0]] == ["2", "3"]
+        assert [v.contig for v in chunks[1][1]] == ["3"]
+
+    def test_merge_multi_contig(self):
+        idx = {"a": 0, "b": 1, "c": 2}
+
+        def mk(cid):
+            return [
+                _variant(c, 1, calls=[_call(cid, (0, 1))]) for c in "12"
+            ]
+
+        out = list(
+            merge_datasets(
+                [mk("a"), mk("b"), mk("c")], idx, contig_runs_unique=True
+            )
+        )
+        assert len(out) == 2
+        assert all(sorted(row) == [0, 1, 2] for row in out)
+
     def test_calls_stream_drops_empty(self):
         idx = {"a": 0}
         vs = [
