@@ -147,6 +147,18 @@ class VariantsPcaDriver:
             len({d.process_index for d in self.mesh.devices.flat}) > 1
         )
 
+    def _sample_sharded(self) -> bool:
+        """Shard the N×N Gramian over the mesh instead of replicating it.
+
+        Explicit via --sample-sharded; auto when N exceeds the threshold
+        (the 100k-sample stress regime, where a replicated G would be tens
+        of GB per device — the wall the reference hit at ~50k samples,
+        VariantsPca.scala:176-177).
+        """
+        if self.conf.sample_sharded is not None:
+            return self.conf.sample_sharded
+        return self.index.size > self.conf.sample_shard_threshold
+
     def _blocks_to_gramian(self, blocks, g_init=None):
         n = self.index.size
         if self._mesh_spans_processes():
@@ -155,9 +167,13 @@ class VariantsPcaDriver:
             # and XLA reduces over ICI/DCN — the result is already global.
             from spark_examples_tpu.parallel.sharded import (
                 gramian_blockwise_global,
+                sharded_gramian_blockwise_global,
             )
 
-            g = gramian_blockwise_global(blocks, n, self.mesh)
+            if self._sample_sharded():
+                g = sharded_gramian_blockwise_global(blocks, n, self.mesh)
+            else:
+                g = gramian_blockwise_global(blocks, n, self.mesh)
         elif self.mesh is not None:
             from spark_examples_tpu.parallel.sharded import (
                 sharded_gramian_blockwise,
@@ -312,9 +328,24 @@ class VariantsPcaDriver:
     def compute_pca(self, g) -> List[Tuple[str, float, float]]:
         import jax.numpy as jnp
 
+        addressable = getattr(g, "is_fully_addressable", True)
         # Row sums reduce on device (mesh collectives when sharded); only
-        # the N-vector reaches the host for the parity print.
-        row_sums = np.asarray(jnp.sum(jnp.asarray(g), axis=1))
+        # the N-vector reaches the host for the parity print. A
+        # process-spanning G needs the reduction replicated so every host
+        # can read the vector.
+        if addressable:
+            row_sums = np.asarray(jnp.sum(jnp.asarray(g), axis=1))
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            row_sums = np.asarray(
+                jax.jit(
+                    lambda a: jnp.sum(a, axis=1),
+                    out_shardings=NamedSharding(
+                        self.mesh, PartitionSpec(None)
+                    ),
+                )(g)
+            )
         nonzero = int((row_sums > 0).sum())
         print(
             f"Non zero rows in matrix: {nonzero} / {self.index.size}."
@@ -322,13 +353,27 @@ class VariantsPcaDriver:
         if self.conf.precise:
             # Host-f64 LAPACK path: implies N is gatherable (the reference
             # gathered the whole matrix to its driver JVM at any N).
+            if not addressable:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                g = jax.jit(
+                    lambda a: a,
+                    out_shardings=NamedSharding(
+                        self.mesh, PartitionSpec(None, None)
+                    ),
+                )(g)
             coords, _ = mllib_principal_components_reference(
                 np.asarray(g), self.conf.num_pc
             )
         elif self.mesh is not None:
             from spark_examples_tpu.parallel.sharded import sharded_pcoa
 
-            coords, _ = sharded_pcoa(g, self.conf.num_pc, self.mesh)
+            coords, _ = sharded_pcoa(
+                g,
+                self.conf.num_pc,
+                self.mesh,
+                dense_eigh_limit=self.conf.dense_eigh_limit,
+            )
             coords = np.asarray(coords)
         else:
             coords, _ = pcoa(g, self.conf.num_pc)

@@ -76,14 +76,20 @@ def allreduce_gramian(g_local, chunk_bytes: int = 64 << 20):
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
-    arr = jnp.asarray(g_local)
-    if not arr.is_fully_addressable:
-        raise NotImplementedError(
-            "Gramian is sharded across processes; the DP-across-hosts "
-            "merge expects per-host partials on local devices. Use a "
-            "per-host mesh (local devices only) together with multi-host "
-            "manifest slicing."
+    if not getattr(g_local, "is_fully_addressable", True):
+        # In this framework a process-spanning array can only come from the
+        # global-mesh accumulators (gramian_blockwise_global / the
+        # sample-sharded pod path), whose every block step was a collective
+        # — it already holds the global sum and must not be "merged" again.
+        # Fail loudly rather than guess: the pod driver path never calls
+        # this function (pca.get_similarity_matrix gates on the mesh).
+        raise ValueError(
+            "allreduce_gramian merges HOST-LOCAL partial Gramians; this "
+            "array is sharded across processes, which the global-mesh "
+            "accumulators produce already globally summed — use their "
+            "result directly instead of re-reducing it"
         )
+    arr = jnp.asarray(g_local)
     n = arr.shape[0]
     itemsize = np.dtype(arr.dtype).itemsize
     rows = max(1, chunk_bytes // max(1, n * itemsize))

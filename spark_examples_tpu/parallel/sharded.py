@@ -38,6 +38,7 @@ __all__ = [
     "gramian_variant_parallel",
     "gramian_variant_parallel_ring",
     "sharded_gramian_blockwise",
+    "sharded_gramian_blockwise_global",
     "sharded_pcoa",
     "topk_eig_randomized",
 ]
@@ -71,6 +72,81 @@ def gramian_variant_parallel(x, mesh: Mesh, compute_dtype=jnp.float32):
     return jax.jit(_local_gramian)(x)
 
 
+def _axis_product(mesh: Mesh, spec: P) -> int:
+    total = 1
+    for entry in spec:
+        if entry is None:
+            continue
+        for name in entry if isinstance(entry, tuple) else (entry,):
+            total *= mesh.shape[name]
+    return total
+
+
+def _mesh_spans_processes(mesh: Mesh) -> bool:
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def _accumulate_blocks(
+    blocks,
+    n_samples: int,
+    mesh: Mesh,
+    x_sharding: NamedSharding,
+    g_sharding: NamedSharding,
+    compute_dtype,
+    accum_dtype,
+):
+    """Shared blockwise-Gramian core: pad, zero-init, accumulate, trim.
+
+    The layout policy lives entirely in the two shardings; the feed policy
+    follows the mesh — a process-spanning mesh gets the width/liveness-
+    synced global stream, a host-local mesh a plain device prefetch (each
+    host accumulating its own partial independently).
+
+    The sample axis is padded to a multiple of the G-sharding axis sizes:
+    N comes from the cohort's callset count, which is arbitrary, and
+    device_put needs sharded dimensions to divide evenly. Zero rows are
+    inert in X @ X.T (zero rows/cols of G), trimmed before returning.
+    """
+    from spark_examples_tpu.arrays.blocks import round_up_multiple
+
+    n_padded = round_up_multiple(
+        n_samples, _axis_product(mesh, g_sharding.spec)
+    )
+
+    @partial(jax.jit, donate_argnums=(0,), out_shardings=g_sharding)
+    def _accum(g, xb):
+        xf = xb.astype(compute_dtype)
+        return g + jnp.einsum(
+            "nv,mv->nm", xf, xf, preferred_element_type=g.dtype
+        )
+
+    def padded_blocks():
+        for block in blocks:
+            xb = np.asarray(block)
+            if n_padded != n_samples:
+                xb = np.pad(xb, ((0, n_padded - n_samples), (0, 0)))
+            yield xb
+
+    g = jax.device_put(
+        jnp.zeros((n_padded, n_padded), dtype=accum_dtype), g_sharding
+    )
+    if _mesh_spans_processes(mesh):
+        stream = _synced_block_stream(padded_blocks(), n_padded, x_sharding)
+    else:
+        from spark_examples_tpu.arrays.feed import device_prefetch
+
+        stream = device_prefetch(padded_blocks(), sharding=x_sharding)
+    for xb in stream:
+        g = _accum(g, xb)
+    if n_padded == n_samples:
+        return g
+    # Trim as a (collective, when process-spanning) jit slice so the
+    # result is never gathered to a host. No explicit out-sharding: the
+    # trimmed dims need not divide the mesh axes; GSPMD keeps the layout
+    # as close as the uneven shape allows.
+    return jax.jit(lambda a: a[:n_samples, :n_samples])(g)
+
+
 def sharded_gramian_blockwise(
     blocks: Iterable[np.ndarray],
     n_samples: int,
@@ -86,40 +162,15 @@ def sharded_gramian_blockwise(
     stays in place in HBM (donated).
     """
     d_axis, m_axis = _mesh_axes(mesh)
-    g_sharding = NamedSharding(mesh, P(d_axis, m_axis))
-    x_sharding = NamedSharding(mesh, P(d_axis, None))
-
-    # Pad the sample axis to a multiple of the mesh axis sizes: N comes
-    # from the cohort's callset count, which is arbitrary, and device_put
-    # requires the sharded dimension to divide evenly. Zero rows are inert
-    # in X @ X.T (zero rows/cols of G), trimmed before returning.
-    from spark_examples_tpu.arrays.blocks import round_up_multiple
-
-    divisor = mesh.shape[d_axis] * (mesh.shape[m_axis] if m_axis else 1)
-    n_padded = round_up_multiple(n_samples, divisor)
-
-    @partial(jax.jit, donate_argnums=(0,), out_shardings=g_sharding)
-    def _accum(g, xb):
-        xf = xb.astype(compute_dtype)
-        return g + jnp.einsum(
-            "nv,mv->nm", xf, xf, preferred_element_type=g.dtype
-        )
-
-    from spark_examples_tpu.arrays.feed import device_prefetch
-
-    def padded_blocks():
-        for block in blocks:
-            xb = np.asarray(block)
-            if n_padded != n_samples:
-                xb = np.pad(xb, ((0, n_padded - n_samples), (0, 0)))
-            yield xb
-
-    g = jax.device_put(
-        jnp.zeros((n_padded, n_padded), dtype=accum_dtype), g_sharding
+    return _accumulate_blocks(
+        blocks,
+        n_samples,
+        mesh,
+        NamedSharding(mesh, P(d_axis, None)),
+        NamedSharding(mesh, P(d_axis, m_axis)),
+        compute_dtype,
+        accum_dtype,
     )
-    for xb in device_prefetch(padded_blocks(), sharding=x_sharding):
-        g = _accum(g, xb)
-    return g[:n_samples, :n_samples]
 
 
 def gramian_variant_parallel_ring(x, mesh: Mesh, compute_dtype=jnp.float32):
@@ -197,46 +248,39 @@ def gramian_blockwise_global(
     all streams drain, and a width mismatch raises on every process
     simultaneously (never a one-sided deadlock).
     """
-    all_axes = tuple(mesh.axis_names)
-    x_sharding = NamedSharding(mesh, P(None, all_axes))
-    g_sharding = NamedSharding(mesh, P(None, None))
-
-    @partial(jax.jit, donate_argnums=(0,), out_shardings=g_sharding)
-    def _accum(g, xb):
-        xf = xb.astype(compute_dtype)
-        return g + jnp.einsum(
-            "nv,mv->nm", xf, xf, preferred_element_type=g.dtype
-        )
-
-    g = jax.device_put(
-        jnp.zeros((n_samples, n_samples), dtype=accum_dtype), g_sharding
+    return _accumulate_blocks(
+        local_blocks,
+        n_samples,
+        mesh,
+        NamedSharding(mesh, P(None, tuple(mesh.axis_names))),
+        NamedSharding(mesh, P(None, None)),
+        compute_dtype,
+        accum_dtype,
     )
 
-    if jax.process_count() == 1:
-        from spark_examples_tpu.arrays.feed import device_prefetch
 
-        for xg in device_prefetch(local_blocks, sharding=x_sharding):
-            g = _accum(g, xg)
-        return g
+def _synced_block_stream(local_blocks, n_samples: int, x_sharding):
+    """Per-step width/liveness-synced global blocks from per-process streams.
 
+    Factored from the pod-mode accumulators: every process learns every
+    peer's block width (−1 = exhausted) BEFORE any collective compute, so
+    width mismatches raise on ALL processes together (one process raising
+    alone would leave peers deadlocked in the next collective) and an
+    exhausted process zero-fills at the peers' width until all streams
+    drain (zero columns are inert in the Gramian).
+    """
     from jax.experimental import multihost_utils
 
     it = iter(local_blocks)
     while True:
         block = next(it, None)
-        # Width sync doubles as the liveness sync: every process learns
-        # every peer's block width (−1 = exhausted) BEFORE any collective
-        # compute, so width mismatches raise on ALL processes together
-        # (one process raising alone would leave peers deadlocked in the
-        # next collective) and an exhausted process learns the width it
-        # must zero-fill.
         w = -1 if block is None else int(np.asarray(block).shape[1])
         peer_widths = np.asarray(
             multihost_utils.process_allgather(np.array([w], np.int64))
         ).ravel()
         live = sorted({int(x) for x in peer_widths if x >= 0})
         if not live:
-            break
+            return
         if len(live) > 1:
             raise ValueError(
                 "block widths differ across processes in the same step: "
@@ -246,11 +290,44 @@ def gramian_blockwise_global(
         width = live[0]
         if block is None:
             block = np.zeros((n_samples, width), np.int8)
-        xg = jax.make_array_from_process_local_data(
+        yield jax.make_array_from_process_local_data(
             x_sharding, np.asarray(block)
         )
-        g = _accum(g, xg)
-    return g
+
+
+def sharded_gramian_blockwise_global(
+    local_blocks,
+    n_samples: int,
+    mesh: Mesh,
+    compute_dtype=jnp.float32,
+    accum_dtype=jnp.float32,
+):
+    """Pod-mode blockwise Gramian with G *sample-sharded* over the mesh.
+
+    The 100k-sample stress regime (BASELINE.md config #5): N is too large
+    to replicate G per device (100k² f32 = 40 GB), so G lives 2D-sharded
+    ``P(data, model)`` across the whole multi-process mesh while each
+    process feeds its own variant columns — the combination the reference
+    could not reach at all (its per-task dense matrix capped it near 50k
+    samples in 20 GB heaps, VariantsPca.scala:176-177). Per-step sync and
+    zero-fill semantics are identical to :func:`gramian_blockwise_global`;
+    the only difference is the output layout, which GSPMD propagates into
+    the einsum (each device builds its own G tile from the gathered block
+    columns — the block all-gather rides ICI, G never moves).
+
+    Returns G still sharded; downstream :func:`sharded_pcoa` consumes it
+    without ever gathering at large N.
+    """
+    d_axis, m_axis = _mesh_axes(mesh)
+    return _accumulate_blocks(
+        local_blocks,
+        n_samples,
+        mesh,
+        NamedSharding(mesh, P(None, tuple(mesh.axis_names))),
+        NamedSharding(mesh, P(d_axis, m_axis)),
+        compute_dtype,
+        accum_dtype,
+    )
 
 
 def topk_eig_randomized(
@@ -259,6 +336,7 @@ def topk_eig_randomized(
     oversample: int = 8,
     iters: int = 30,
     seed: int = 0,
+    mesh: Mesh = None,
 ):
     """Top-|λ| eigenpairs of symmetric C by randomized subspace iteration.
 
@@ -282,6 +360,16 @@ def topk_eig_randomized(
     n = c.shape[0]
     p = min(n, k + oversample)
     q0 = jax.random.normal(jax.random.PRNGKey(seed), (n, p), dtype=c.dtype)
+    if mesh is not None and jax.process_count() > 1:
+        # Multi-controller: the panel must be a global (replicated) array
+        # to enter a jit alongside the process-spanning C — every process
+        # derives the identical panel from the same key.
+        host_q0 = np.asarray(q0)
+        q0 = jax.make_array_from_callback(
+            host_q0.shape,
+            NamedSharding(mesh, P(None, None)),
+            lambda idx: host_q0[idx],
+        )
 
     @partial(jax.jit, static_argnames=("iters",))
     def _run(c, q, iters):
@@ -299,6 +387,14 @@ def topk_eig_randomized(
         return vecs, w[order]
 
     vecs, vals = _run(c, q0, iters)
+    if mesh is not None and jax.process_count() > 1:
+        # The (N, k+p) panel result is small even at stress N; replicate it
+        # so hosts can read coordinates without touching the sharded C.
+        rep = NamedSharding(mesh, P(None, None))
+        vecs = jax.jit(lambda a: a, out_shardings=rep)(vecs)
+        vals = jax.jit(
+            lambda a: a, out_shardings=NamedSharding(mesh, P(None))
+        )(vals)
     return normalize_eigvec_signs(vecs[:, :k]), vals[:k]
 
 
@@ -307,13 +403,21 @@ def sharded_pcoa(g, k: int, mesh: Mesh, dense_eigh_limit: int = 8192):
 
     Small N: gather the centered matrix and run dense ``eigh`` (exact, the
     replicated-eigh fallback of SURVEY.md §7). Large N: keep C sharded and
-    use randomized subspace iteration.
+    use randomized subspace iteration — at the stress scale C is never
+    materialized on any single device or host.
     """
     c = jax.jit(double_center)(g)
     n = c.shape[0]
     if n <= dense_eigh_limit:
+        if not c.is_fully_addressable:
+            # Process-spanning shards: replicate through a collective jit
+            # (affordable by definition at dense-eigh N) so the host can
+            # read it.
+            c = jax.jit(
+                lambda a: a, out_shardings=NamedSharding(mesh, P(None, None))
+            )(c)
         c = jax.device_put(np.asarray(c))
         from spark_examples_tpu.ops.pcoa import principal_components
 
         return principal_components(c, k)
-    return topk_eig_randomized(c, k)
+    return topk_eig_randomized(c, k, mesh=mesh)

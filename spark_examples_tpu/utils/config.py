@@ -73,6 +73,14 @@ class PcaConfig(GenomicsConfig):
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 64  # shards per Gramian snapshot
     trace_dir: Optional[str] = None  # jax.profiler trace output
+    # The 100k-sample stress regime (BASELINE.md config #5): shard the N×N
+    # Gramian over the mesh instead of replicating it. None = auto (shard
+    # when N exceeds sample_shard_threshold).
+    sample_sharded: Optional[bool] = None
+    sample_shard_threshold: int = 16384
+    # N above which the PCoA eigendecomposition switches from dense eigh
+    # to randomized subspace iteration (the sharded-eig path).
+    dense_eigh_limit: int = 8192
 
 
 def add_genomics_flags(p: argparse.ArgumentParser) -> None:
@@ -147,6 +155,24 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         "--trace-dir",
         default=None,
         help="Write a jax.profiler trace of the run here",
+    )
+    p.add_argument(
+        "--sample-sharded",
+        action=argparse.BooleanOptionalAction,
+        default=None,
+        help="Shard the N×N Gramian over the mesh (default: auto above "
+        "--sample-shard-threshold; --no-sample-sharded forces the "
+        "replicated-G path); the 100k-sample stress regime",
+    )
+    p.add_argument(
+        "--sample-shard-threshold", type=int, default=16384
+    )
+    p.add_argument(
+        "--dense-eigh-limit",
+        type=int,
+        default=8192,
+        help="N above which eigendecomposition uses randomized subspace "
+        "iteration instead of dense eigh",
     )
 
 

@@ -297,6 +297,120 @@ def test_global_mesh_gramian_two_processes(tmp_path):
     )
 
 
+_SAMPLE_SHARDED_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from spark_examples_tpu.parallel.distributed import initialize_from_env
+    assert initialize_from_env()
+
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    pid = jax.process_index()
+    # 2 processes x 4 local devices; rows of the device grid are the
+    # process boundary, so the "data" (sample-row) axis of G spans DCN and
+    # "model" stays on-host — the stress config's layout at test scale.
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "model"))
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,
+        block_variants=32,
+        sample_sharded=True,
+        dense_eigh_limit=8,  # force the randomized sharded-eig path
+    )
+    driver = VariantsPcaDriver(
+        conf, synthetic_cohort(24, 96, seed=3), mesh=mesh
+    )
+    assert driver._mesh_spans_processes()
+    assert driver._sample_sharded()
+    result = driver.run()
+
+    if pid == 0:
+        with open(sys.argv[1], "w") as f:
+            json.dump(
+                {"driver_result": [[r[0], r[1], r[2]] for r in result]}, f
+            )
+    """
+)
+
+
+def test_sample_sharded_pod_two_processes(tmp_path):
+    """The 100k-stress path at test scale: G sample-sharded P(data, model)
+    over a 2-process x 4-device mesh, randomized sharded eig, full driver —
+    matches the single-process sample-sharded run."""
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(_SAMPLE_SHARDED_WORKER)
+    out_file = tmp_path / "result.json"
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "JAX_NUM_PROCESSES": "2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(out_file)],
+            env={
+                **env,
+                "JAX_PROCESS_ID": str(i),
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            },
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for i in range(2)
+    ]
+    try:
+        logs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, log[-2000:]
+    result = json.loads(out_file.read_text())
+
+    # Single-process golden: same config (sample-sharded + randomized eig)
+    # on a local data:2,model:2 mesh — same math, different distribution.
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.parallel.mesh import make_mesh
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,
+        block_variants=32,
+        sample_sharded=True,
+        dense_eigh_limit=8,
+    )
+    single = VariantsPcaDriver(
+        conf,
+        synthetic_cohort(24, 96, seed=3),
+        mesh=make_mesh("data:2,model:2"),
+    ).run()
+    np.testing.assert_allclose(
+        np.array([r[1:] for r in result["driver_result"]], dtype=float),
+        np.array([r[1:] for r in single]),
+        atol=1e-4,
+    )
+
+
 _CHECKPOINT_WORKER = textwrap.dedent(
     """
     import json, os, sys
