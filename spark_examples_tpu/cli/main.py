@@ -42,11 +42,23 @@ def _add_fixture_flags(p: argparse.ArgumentParser) -> None:
     )
 
 
-def _resolve_source(args, references: str):
-    # Offline sources (fixture/JSONL) never consume credentials, so
-    # --client-secrets stays inert for them; a network VariantSource
-    # resolves its credential via genomics.auth.get_access_token (the
-    # Authentication.getAccessToken analog, Client.scala:29-46).
+def _network_source(args):
+    """HTTP source with credentials — the Client(auth) construction.
+
+    Resolves the credential once on the driver via get_access_token (the
+    Authentication.getAccessToken analog, Client.scala:29-46) and ships it
+    on every per-shard request.
+    """
+    from spark_examples_tpu.genomics.auth import get_access_token
+    from spark_examples_tpu.genomics.service import HttpVariantSource
+
+    return HttpVariantSource(
+        args.api_url, credentials=get_access_token(args.client_secrets)
+    )
+
+
+def _offline_source(args, references: str):
+    """JSONL-dir or synthetic-fixture source, or None if neither flagged."""
     if args.input_path:
         return JsonlSource(args.input_path)
     if args.fixture_samples:
@@ -58,11 +70,22 @@ def _resolve_source(args, references: str):
             sparse_calls=args.fixture_sparse_calls,
             variant_set_id=(args.variant_set_ids or [DEFAULT_VARIANT_SET_ID])[0],
         )
-    raise SystemExit(
-        "No data source: pass --input-path <jsonl cohort dir> or "
-        "--fixture-samples N (the Genomics v1 API is retired; network "
-        "sources implement the VariantSource protocol)"
-    )
+    return None
+
+
+def _resolve_source(args, references: str):
+    # Offline sources (fixture/JSONL) never consume credentials;
+    # --client-secrets applies to the network source only.
+    if args.api_url:
+        return _network_source(args)
+    source = _offline_source(args, references)
+    if source is None:
+        raise SystemExit(
+            "No data source: pass --api-url <service>, --input-path "
+            "<jsonl cohort dir>, or --fixture-samples N (the Genomics v1 "
+            "API is retired; serve-cohort hosts a compatible service)"
+        )
+    return source
 
 
 def _cmd_pca(args) -> int:
@@ -124,6 +147,8 @@ def _resolve_reads_source(args, references: str):
     """Returns (source, read_group_set_id)."""
     from spark_examples_tpu.genomics.fixtures import FIXTURE_READSET_ID
 
+    if args.api_url:
+        return _network_source(args), (args.read_group_set_id or "")
     if args.input_path:
         # Local cohorts default to no readset filter (serve whatever the
         # directory holds); --read-group-set-id narrows it.
@@ -201,7 +226,11 @@ def _cmd_reads_example(args) -> int:
         )
 
         refs = args.references or "1:100000000:101000000"
-        if args.input_path:
+        if args.api_url:
+            source = _network_source(args)
+            normal_id = args.normal_id or NORMAL_READSET_ID
+            tumor_id = args.tumor_id or TUMOR_READSET_ID
+        elif args.input_path:
             source = JsonlSource(args.input_path)
             # Local cohorts default to the fixture pair ids (the DREAM API
             # ids remain available via the flags).
@@ -214,7 +243,8 @@ def _cmd_reads_example(args) -> int:
             normal_id, tumor_id = NORMAL_READSET_ID, TUMOR_READSET_ID
         else:
             raise SystemExit(
-                "No reads source: pass --input-path or --fixture-reads N"
+                "No reads source: pass --api-url, --input-path, or "
+                "--fixture-reads N"
             )
         out = sr.tumor_normal_diff(
             source,
@@ -256,6 +286,31 @@ def _cmd_pca_bridge(args) -> int:
         import threading
 
         threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def _cmd_serve_cohort(args) -> int:
+    """Host a cohort as a Genomics-compatible HTTP service."""
+    from spark_examples_tpu.genomics.service import GenomicsServiceServer
+
+    source = _offline_source(args, args.references)
+    if source is None:
+        raise SystemExit(
+            "serve-cohort needs --input-path <jsonl dir> or "
+            "--fixture-samples N"
+        )
+    server = GenomicsServiceServer(
+        source, port=args.port, token=args.token, host=args.host
+    )
+    print(
+        f"Genomics service listening on http://{args.host}:{server.port}"
+        + (" (token auth)" if args.token else ""),
+        flush=True,
+    )
+    try:
+        server.serve_forever()
     except KeyboardInterrupt:
         server.stop()
     return 0
@@ -343,6 +398,21 @@ def build_parser() -> argparse.ArgumentParser:
     add_pca_flags(bridge)
     bridge.add_argument("--port", type=int, default=18717)
     bridge.set_defaults(fn=_cmd_pca_bridge)
+
+    serve = sub.add_parser(
+        "serve-cohort",
+        help="Host a cohort as a Genomics-compatible HTTP service",
+    )
+    add_pca_flags(serve)
+    _add_fixture_flags(serve)
+    serve.add_argument("--port", type=int, default=18718)
+    serve.add_argument("--host", default="127.0.0.1")
+    serve.add_argument(
+        "--token",
+        default=None,
+        help="Require this bearer token on every request",
+    )
+    serve.set_defaults(fn=_cmd_serve_cohort)
 
     return p
 

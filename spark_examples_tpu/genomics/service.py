@@ -1,0 +1,323 @@
+"""HTTP genomics service — the network VariantSource/ReadSource pair.
+
+Reference mapping: each compute task's server-streaming gRPC request per
+shard (``VariantsRDD.scala:205-235``) becomes one HTTP GET per shard
+returning newline-JSON records, and the callset metadata lookup
+(``Paginator.Callsets`` over REST, ``VariantsCommon.scala:40-43``) becomes
+``GET /callsets``. The v1 API is retired, so the server half here fronts
+any local :class:`~spark_examples_tpu.genomics.sources.VariantSource`
+(fixture or JSONL cohort) — a self-hosted Genomics-compatible service for
+tests, benchmarks, and remote-cohort runs.
+
+Authentication follows ``Client(auth)`` (``Client.scala:49-61``): the
+client resolves a :class:`~spark_examples_tpu.genomics.auth.Credentials`
+once (the ``Authentication.getAccessToken`` analog) and ships its token as
+a ``Bearer`` header on every request; a token-configured server rejects
+anything else with 401. Failed responses feed
+``IoStats.unsuccessful_responses`` and transport failures
+``IoStats.io_exceptions`` — the exact counters the reference's client
+wrapper flushes into Spark accumulators (``VariantsRDD.scala:199-203``).
+
+Wire format: the JSONL interchange schema of :mod:`.sources` (one record
+per line), so ``HttpVariantSource`` over a served cohort is
+record-for-record identical to reading it locally with ``JsonlSource``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Iterator, List, Optional
+from urllib.parse import parse_qs, urlencode, urlparse
+
+from spark_examples_tpu.genomics.auth import Credentials
+from spark_examples_tpu.genomics.shards import Shard
+from spark_examples_tpu.genomics.sources import (
+    Callset,
+    _read_to_record,
+    _variant_to_record,
+    read_from_record,
+    variant_from_record,
+)
+from spark_examples_tpu.genomics.types import Read, Variant
+from spark_examples_tpu.utils.stats import IoStats
+
+__all__ = ["GenomicsServiceServer", "HttpVariantSource"]
+
+# Explicit application-level end-of-stream frame. HTTP chunked truncation
+# is NOT reliably detectable through http.client's line iteration (its
+# read1/peek paths swallow IncompleteRead and report a clean EOF), so the
+# stream is complete only when this sentinel line arrives; anything else
+# is a truncated shard and must error, never feed partial data downstream.
+_END_SENTINEL = b'{"__end__": true}'
+
+
+def _make_handler(source, token: Optional[str]):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):  # quiet: tests run many requests
+            pass
+
+        def _authorized(self) -> bool:
+            if token is None:
+                return True
+            import hmac
+
+            return hmac.compare_digest(
+                self.headers.get("Authorization", ""), f"Bearer {token}"
+            )
+
+        def _deny(self) -> None:
+            body = b'{"error": "unauthorized"}\n'
+            self.send_response(401)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_lines(self, lines: Iterator[bytes]) -> None:
+            # Chunked transfer: record count is unknown up front (the
+            # server-streaming shape of VariantStreamIterator). Headers go
+            # out lazily so a source that fails BEFORE yielding anything
+            # still gets a clean 500 from do_GET.
+            started = False
+            try:
+                for line in lines:
+                    if not started:
+                        self.send_response(200)
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        started = True
+                    payload = line + b"\n"
+                    self.wfile.write(f"{len(payload):x}\r\n".encode())
+                    self.wfile.write(payload + b"\r\n")
+            except Exception:
+                if not started:
+                    raise
+                # Mid-stream source failure with a 200 already on the
+                # wire: drop the connection without the end sentinel — the
+                # client treats a sentinel-less stream as truncated.
+                self.close_connection = True
+                return
+            if not started:
+                self.send_response(200)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+            payload = _END_SENTINEL + b"\n"
+            self.wfile.write(f"{len(payload):x}\r\n".encode())
+            self.wfile.write(payload + b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            if not self._authorized():
+                self._deny()
+                return
+            url = urlparse(self.path)
+            q = {k: v[0] for k, v in parse_qs(url.query).items()}
+            try:
+                if url.path == "/callsets":
+                    rows = [
+                        {
+                            "id": c.id,
+                            "name": c.name,
+                            "variant_set_id": c.variant_set_id,
+                        }
+                        for c in source.list_callsets(
+                            q.get("variant_set_id", "")
+                        )
+                    ]
+                    body = (json.dumps(rows) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif url.path == "/variants":
+                    shard = Shard(
+                        q["contig"], int(q["start"]), int(q["end"])
+                    )
+                    self._send_lines(
+                        json.dumps(
+                            _variant_to_record(v)
+                            if isinstance(v, Variant)
+                            else v
+                        ).encode()
+                        for v in source.stream_variants(
+                            q.get("variant_set_id", ""), shard
+                        )
+                    )
+                elif url.path == "/reads":
+                    shard = Shard(
+                        q["contig"], int(q["start"]), int(q["end"])
+                    )
+                    self._send_lines(
+                        json.dumps(
+                            _read_to_record(r) if isinstance(r, Read) else r
+                        ).encode()
+                        for r in source.stream_reads(
+                            q.get("read_group_set_id", ""), shard
+                        )
+                    )
+                else:
+                    self.send_error(404)
+            except (KeyError, ValueError) as e:
+                self.send_error(400, str(e))
+            except Exception as e:  # noqa: BLE001 — surface, don't hang
+                self.send_error(500, str(e))
+
+    return Handler
+
+
+class GenomicsServiceServer:
+    """Serve a cohort source over HTTP (threaded; one shard per request)."""
+
+    def __init__(
+        self,
+        source,
+        port: int = 0,
+        token: Optional[str] = None,
+        host: str = "127.0.0.1",
+    ):
+        self._srv = ThreadingHTTPServer(
+            (host, port), _make_handler(source, token)
+        )
+        self._srv.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    def start(self) -> "GenomicsServiceServer":
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._srv.serve_forever()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class HttpVariantSource:
+    """Network VariantSource/ReadSource over the HTTP service.
+
+    One request per shard — the unit of data parallelism, exactly the
+    reference's one-gRPC-stream-per-partition (``VariantsRDD.scala:
+    205-211``). Records pass through the same builder path as every other
+    source (contig drop + STRICT semantics are server-side, mirroring the
+    enforceShardBoundary server contract; the builder re-applies the
+    contig rule defensively).
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        credentials: Optional[Credentials] = None,
+        stats: Optional[IoStats] = None,
+        timeout: float = 60.0,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self._token = credentials.token if credentials else ""
+        self.stats = stats if stats is not None else IoStats()
+        self._timeout = timeout
+
+    def _request(self, path: str, params: dict):
+        url = f"{self.base_url}{path}?{urlencode(params)}"
+        req = urllib.request.Request(url)
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        self.stats.add(requests=1)
+        try:
+            return urllib.request.urlopen(req, timeout=self._timeout)
+        except urllib.error.HTTPError as e:
+            # A served error response (401/404/500): the reference counts
+            # these as unsuccessfulResponses (Client.scala:59).
+            self.stats.add(unsuccessful_responses=1)
+            raise IOError(f"{path}: HTTP {e.code} {e.reason}") from e
+        except urllib.error.URLError as e:
+            # No response at all — transport trouble (ioExceptions).
+            self.stats.add(io_exceptions=1)
+            raise IOError(f"{path}: {e.reason}") from e
+
+    def list_callsets(self, variant_set_id: str) -> List[Callset]:
+        with self._request(
+            "/callsets", {"variant_set_id": variant_set_id}
+        ) as resp:
+            rows = json.load(resp)
+        return [
+            Callset(r["id"], r["name"], r.get("variant_set_id", ""))
+            for r in rows
+        ]
+
+    def stream_variants(
+        self, variant_set_id: str, shard: Shard
+    ) -> Iterator[Variant]:
+        self.stats.add(partitions=1, reference_bases=shard.range)
+        resp = self._request(
+            "/variants",
+            {
+                "variant_set_id": variant_set_id,
+                "contig": shard.contig,
+                "start": shard.start,
+                "end": shard.end,
+            },
+        )
+        for line in self._stream_lines(resp, "/variants"):
+            v = variant_from_record(json.loads(line))
+            if v is None:
+                continue
+            self.stats.add(variants_read=1)
+            yield v
+
+    def _stream_lines(self, resp, path: str) -> Iterator[bytes]:
+        """Iterate response lines up to the end sentinel.
+
+        A stream that ends any other way — connection drop, truncation,
+        proxy cutoff — counts as an IO exception and raises; partial
+        shards must never feed the pipeline silently (see _END_SENTINEL).
+        """
+        import http.client
+
+        complete = False
+        try:
+            with resp:
+                for line in resp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    if line == _END_SENTINEL:
+                        complete = True
+                        break
+                    yield line
+        except (http.client.HTTPException, OSError) as e:
+            self.stats.add(io_exceptions=1)
+            raise IOError(f"{path}: stream aborted mid-shard: {e}") from e
+        if not complete:
+            self.stats.add(io_exceptions=1)
+            raise IOError(
+                f"{path}: stream aborted mid-shard (no end-of-stream frame)"
+            )
+
+    def stream_reads(
+        self, read_group_set_id: str, shard: Shard
+    ) -> Iterator[Read]:
+        self.stats.add(partitions=1, reference_bases=shard.range)
+        resp = self._request(
+            "/reads",
+            {
+                "read_group_set_id": read_group_set_id,
+                "contig": shard.contig,
+                "start": shard.start,
+                "end": shard.end,
+            },
+        )
+        for line in self._stream_lines(resp, "/reads"):
+            self.stats.add(reads_read=1)
+            yield read_from_record(json.loads(line))

@@ -10,8 +10,10 @@ implementations:
   source (the "fake genomics service" SURVEY.md §4 calls for);
 - :class:`JsonlSource` — newline-JSON files on disk (offline cohorts,
   optionally gzipped), one record per line;
-- a network source can implement the same protocol against any
-  Genomics-v1-compatible server (see ``spark_examples_tpu.bridge``).
+- :class:`~spark_examples_tpu.genomics.service.HttpVariantSource` — the
+  network source (one HTTP request per shard against the served cohort
+  endpoint of :mod:`spark_examples_tpu.genomics.service`, with Bearer-token
+  auth from :mod:`spark_examples_tpu.genomics.auth`).
 
 All sources enforce the STRICT boundary rule: a record is yielded by exactly
 the shard containing its start coordinate, so no deduplication pass is
@@ -128,6 +130,25 @@ def _variant_to_record(v: Variant) -> dict:
 
 
 def read_from_record(rec: dict) -> Read:
+    if "cigar" in rec and "cigar_ops" not in rec:
+        # Already-assembled SAM cigar (a re-serialized Read, e.g. over the
+        # HTTP service): reconstruct directly — Read.build only converts
+        # enum op tuples.
+        return Read(
+            aligned_quality=tuple(rec.get("aligned_quality", ())),
+            cigar=rec["cigar"],
+            id=rec.get("id", ""),
+            mapping_quality=rec.get("mapping_quality", 0),
+            mate_position=rec.get("mate_position", -1),
+            mate_reference_name=rec.get("mate_reference_name", ""),
+            fragment_name=rec.get("fragment_name", ""),
+            aligned_sequence=rec.get("aligned_sequence", ""),
+            position=rec["position"],
+            read_group_set_id=rec.get("read_group_set_id", ""),
+            reference_name=rec["reference_name"],
+            info={k: tuple(v) for k, v in rec.get("info", {}).items()},
+            fragment_length=rec.get("fragment_length", 0),
+        )
     return Read.build(
         rec["reference_name"],
         rec["position"],
@@ -143,6 +164,24 @@ def read_from_record(rec: dict) -> Read:
         info=rec.get("info"),
         fragment_length=rec.get("fragment_length", 0),
     )
+
+
+def _read_to_record(r: Read) -> dict:
+    return {
+        "reference_name": r.reference_name,
+        "position": r.position,
+        "aligned_sequence": r.aligned_sequence,
+        "cigar": r.cigar,
+        "aligned_quality": list(r.aligned_quality),
+        "id": r.id,
+        "mapping_quality": r.mapping_quality,
+        "mate_position": r.mate_position,
+        "mate_reference_name": r.mate_reference_name,
+        "fragment_name": r.fragment_name,
+        "read_group_set_id": r.read_group_set_id,
+        "info": {k: list(v) for k, v in r.info.items()},
+        "fragment_length": r.fragment_length,
+    }
 
 
 def _strip_chr(name: str) -> str:

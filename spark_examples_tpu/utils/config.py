@@ -93,7 +93,15 @@ def add_genomics_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--client-secrets",
         default=None,
-        help="Accepted for CLI parity; authentication is source-specific",
+        help="Client-secrets JSON for network-source auth (interactive "
+        "confirmation required, Client.scala:32-41 semantics); offline "
+        "sources ignore it",
+    )
+    p.add_argument(
+        "--api-url",
+        default=None,
+        help="Base URL of a Genomics-compatible HTTP service to ingest "
+        "from (see the serve-cohort subcommand)",
     )
     p.add_argument(
         "--input-path",

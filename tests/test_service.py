@@ -1,0 +1,184 @@
+"""Network VariantSource/ReadSource over the HTTP genomics service.
+
+Covers the VERDICT round-1 gaps: a networked streaming ingest source
+(VariantsRDD.scala:205-235 analog), auth consumed by ingest
+(Client.scala:49-61), and unsuccessful_responses fed on real failures.
+"""
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.genomics.auth import Credentials
+from spark_examples_tpu.genomics.fixtures import (
+    DEFAULT_VARIANT_SET_ID,
+    synthetic_cohort,
+    synthetic_reads,
+)
+from spark_examples_tpu.genomics.service import (
+    GenomicsServiceServer,
+    HttpVariantSource,
+)
+from spark_examples_tpu.genomics.shards import shards_for_references
+from spark_examples_tpu.genomics.sources import JsonlSource
+
+REFS = "17:41196311:41277499"
+
+
+@pytest.fixture()
+def served_cohort():
+    src = synthetic_cohort(8, 60, seed=9)
+    src.add_reads(
+        synthetic_reads(
+            20, references="17:41200000:41210000", seed=9
+        ).reads_records()
+    )
+    server = GenomicsServiceServer(src).start()
+    try:
+        yield src, HttpVariantSource(f"http://127.0.0.1:{server.port}")
+    finally:
+        server.stop()
+
+
+class TestStreamParity:
+    def test_variants_match_local_jsonl(self, served_cohort, tmp_path):
+        src, http = served_cohort
+        src.dump(str(tmp_path / "cohort"))
+        local = JsonlSource(str(tmp_path / "cohort"))
+        shards = shards_for_references(REFS, 20_000)
+        for shard in shards:
+            got = list(
+                http.stream_variants(DEFAULT_VARIANT_SET_ID, shard)
+            )
+            want = list(
+                local.stream_variants(DEFAULT_VARIANT_SET_ID, shard)
+            )
+            assert got == want  # frozen dataclasses: field-exact
+        assert http.stats.variants_read == 60
+        assert http.stats.partitions == len(shards)
+        assert http.stats.unsuccessful_responses == 0
+
+    def test_reads_roundtrip(self, served_cohort, tmp_path):
+        src, http = served_cohort
+        src.dump(str(tmp_path / "cohort"))
+        local = JsonlSource(str(tmp_path / "cohort"))
+        for shard in shards_for_references("17:41200000:41210000", 5_000):
+            got = list(http.stream_reads("", shard))
+            want = list(local.stream_reads("", shard))
+            assert got == want
+
+    def test_callsets(self, served_cohort):
+        src, http = served_cohort
+        assert http.list_callsets(DEFAULT_VARIANT_SET_ID) == (
+            src.list_callsets(DEFAULT_VARIANT_SET_ID)
+        )
+
+
+class TestAuth:
+    def test_token_required(self):
+        src = synthetic_cohort(4, 10, seed=1)
+        server = GenomicsServiceServer(src, token="sekrit").start()
+        url = f"http://127.0.0.1:{server.port}"
+        shard = shards_for_references(REFS, 100_000)[0]
+        try:
+            anonymous = HttpVariantSource(url)
+            with pytest.raises(IOError, match="401"):
+                list(anonymous.stream_variants("", shard))
+            assert anonymous.stats.unsuccessful_responses == 1
+
+            wrong = HttpVariantSource(
+                url, credentials=Credentials("nope", "client-secrets")
+            )
+            with pytest.raises(IOError, match="401"):
+                wrong.list_callsets("")
+            assert wrong.stats.unsuccessful_responses == 1
+
+            good = HttpVariantSource(
+                url, credentials=Credentials("sekrit", "client-secrets")
+            )
+            assert len(list(good.stream_variants("", shard))) == 10
+            assert good.stats.unsuccessful_responses == 0
+        finally:
+            server.stop()
+
+    def test_midstream_failure_raises_not_truncates(self):
+        """A source dying after the 200 is on the wire must abort the
+        chunked stream so the client errors — never a silent partial
+        shard feeding the Gramian."""
+        inner = synthetic_cohort(4, 10, seed=1)
+
+        class FailsMidStream:
+            def list_callsets(self, vsid):
+                return inner.list_callsets(vsid)
+
+            def stream_variants(self, vsid, shard):
+                for i, v in enumerate(
+                    inner.stream_variants(vsid, shard)
+                ):
+                    if i == 3:
+                        raise IOError("disk died mid-shard")
+                    yield v
+
+            def stream_reads(self, rgsid, shard):
+                return inner.stream_reads(rgsid, shard)
+
+        server = GenomicsServiceServer(FailsMidStream()).start()
+        try:
+            http = HttpVariantSource(f"http://127.0.0.1:{server.port}")
+            shard = shards_for_references(REFS, 100_000)[0]
+            with pytest.raises(IOError, match="aborted mid-shard"):
+                list(http.stream_variants("", shard))
+            assert http.stats.io_exceptions == 1
+        finally:
+            server.stop()
+
+    def test_prestream_failure_is_unsuccessful_response(self):
+        """Fault injection BEFORE any record: a clean 500 counted as an
+        unsuccessful response (the reference's failed-request counter)."""
+        src = synthetic_cohort(4, 10, seed=1)
+        shard = shards_for_references(REFS, 100_000)[0]
+        src._fail_once.add(shard)
+        server = GenomicsServiceServer(src).start()
+        try:
+            http = HttpVariantSource(f"http://127.0.0.1:{server.port}")
+            with pytest.raises(IOError, match="500"):
+                list(http.stream_variants("", shard))
+            assert http.stats.unsuccessful_responses == 1
+            # Idempotent manifest: the retry succeeds (fault cleared).
+            assert len(list(http.stream_variants("", shard))) == 10
+        finally:
+            server.stop()
+
+    def test_transport_failure_counts_io_exceptions(self):
+        src = synthetic_cohort(4, 10, seed=1)
+        server = GenomicsServiceServer(src).start()
+        url = f"http://127.0.0.1:{server.port}"
+        server.stop()  # port now closed: no response at all
+        http = HttpVariantSource(url, timeout=5)
+        shard = shards_for_references(REFS, 100_000)[0]
+        with pytest.raises(IOError):
+            list(http.stream_variants("", shard))
+        assert http.stats.io_exceptions == 1
+        assert http.stats.unsuccessful_responses == 0
+
+
+class TestPipelineOverNetwork:
+    def test_pca_driver_matches_local(self, served_cohort):
+        src, http = served_cohort
+        from spark_examples_tpu.models.pca import VariantsPcaDriver
+        from spark_examples_tpu.utils.config import PcaConfig
+
+        conf = PcaConfig(
+            variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+            bases_per_partition=20_000,
+            block_variants=32,
+        )
+        remote = VariantsPcaDriver(conf, http).run()
+        local = VariantsPcaDriver(
+            conf, synthetic_cohort(8, 60, seed=9)
+        ).run()
+        assert [r[0] for r in remote] == [r[0] for r in local]
+        np.testing.assert_allclose(
+            np.array([r[1:] for r in remote]),
+            np.array([r[1:] for r in local]),
+            atol=1e-6,
+        )
