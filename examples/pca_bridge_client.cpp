@@ -1,0 +1,159 @@
+// Minimal non-Python PcaBackend bridge client.
+//
+// Proves the bridge protocol claim (spark_examples_tpu/bridge/backend.py:
+// newline-JSON over TCP, init/calls/finish) from a foreign runtime — the
+// role the reference's JVM driver plays when delegating its dense math
+// (the RDD[Seq[Int]] stage boundary of VariantsPca.scala:153-168, shipped
+// through the py4j seam in src/main/python/variants_pca.py:162-182).
+//
+// No JSON library: the protocol is line-delimited and the payload is
+// integer index lists, so requests are assembled with printf-style
+// formatting and the single response line is validated by substring
+// checks plus a numeric parse of the first coordinate row. A real JVM/C++
+// driver would link a JSON library; the wire bytes are identical.
+//
+// Usage: pca_bridge_client <port>
+//   - sends a deterministic 6-sample cohort (3 variant batches)
+//   - expects {"coords": [[...], ...], "eigvals": [...]}
+//   - exits 0 iff coords parse as 6 rows of 2 finite doubles
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+static bool send_line(int fd, const std::string& line) {
+  std::string framed = line + "\n";
+  size_t off = 0;
+  while (off < framed.size()) {
+    ssize_t n = ::send(fd, framed.data() + off, framed.size() - off, 0);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+static bool recv_line(int fd, std::string* out) {
+  out->clear();
+  char c;
+  while (true) {
+    ssize_t n = ::recv(fd, &c, 1, 0);
+    if (n <= 0) return !out->empty();
+    if (c == '\n') return true;
+    out->push_back(c);
+  }
+}
+
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    std::fprintf(stderr, "usage: %s <port>\n", argv[0]);
+    return 2;
+  }
+  int port = std::atoi(argv[1]);
+
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    std::perror("socket");
+    return 1;
+  }
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  ::inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    std::perror("connect");
+    return 1;
+  }
+
+  // A 6-sample cohort: samples {0,1,2} co-vary and {3,4,5} co-vary, so the
+  // first principal coordinate must separate the two groups.
+  const char* init = "{\"cmd\": \"init\", \"n_samples\": 6, \"num_pc\": 2}";
+  const char* batches[] = {
+      "{\"cmd\": \"calls\", \"batch\": [[0, 1, 2], [0, 1], [1, 2]]}",
+      "{\"cmd\": \"calls\", \"batch\": [[3, 4, 5], [3, 4]]}",
+      "{\"cmd\": \"calls\", \"batch\": [[4, 5], [0, 1, 2], [3, 4, 5]]}",
+  };
+  if (!send_line(fd, init)) return 1;
+  for (const char* b : batches) {
+    if (!send_line(fd, b)) return 1;
+  }
+  if (!send_line(fd, "{\"cmd\": \"finish\"}")) return 1;
+
+  std::string resp;
+  if (!recv_line(fd, &resp)) {
+    std::fprintf(stderr, "no response\n");
+    return 1;
+  }
+  ::close(fd);
+
+  if (resp.find("\"error\"") != std::string::npos) {
+    std::fprintf(stderr, "server error: %s\n", resp.c_str());
+    return 1;
+  }
+  if (resp.find("\"coords\"") == std::string::npos ||
+      resp.find("\"eigvals\"") == std::string::npos) {
+    std::fprintf(stderr, "malformed response: %s\n", resp.c_str());
+    return 1;
+  }
+
+  // Parse every coordinate row: after "coords": [[r0], [r1], ...],
+  // stopping at the "]]" that closes the coords array so a short row
+  // count can never be padded out by parsing into eigvals.
+  size_t pos = resp.find("\"coords\"");
+  pos = resp.find('[', pos);
+  size_t coords_end = resp.find("]]", pos);
+  if (coords_end == std::string::npos) {
+    std::fprintf(stderr, "unterminated coords array\n");
+    return 1;
+  }
+  std::vector<std::vector<double>> rows;
+  size_t cursor = pos + 1;
+  while (rows.size() < 6) {
+    size_t open = resp.find('[', cursor);
+    size_t close = resp.find(']', open);
+    if (open == std::string::npos || close == std::string::npos ||
+        open > coords_end) {
+      break;
+    }
+    std::string body = resp.substr(open + 1, close - open - 1);
+    std::vector<double> row;
+    const char* p = body.c_str();
+    char* end = nullptr;
+    while (true) {
+      double v = std::strtod(p, &end);
+      if (end == p) break;
+      row.push_back(v);
+      p = end;
+      while (*p == ',' || *p == ' ') ++p;
+    }
+    rows.push_back(row);
+    cursor = close + 1;
+  }
+  if (rows.size() != 6) {
+    std::fprintf(stderr, "expected 6 coordinate rows, got %zu\n",
+                 rows.size());
+    return 1;
+  }
+  for (const auto& row : rows) {
+    if (row.size() != 2 || !std::isfinite(row[0]) || !std::isfinite(row[1])) {
+      std::fprintf(stderr, "bad coordinate row\n");
+      return 1;
+    }
+  }
+  // Group structure check: PC1 separates {0,1,2} from {3,4,5}.
+  double lo = (rows[0][0] + rows[1][0] + rows[2][0]) / 3.0;
+  double hi = (rows[3][0] + rows[4][0] + rows[5][0]) / 3.0;
+  if ((lo > 0) == (hi > 0)) {
+    std::fprintf(stderr, "PC1 did not separate the two sample groups\n");
+    return 1;
+  }
+  std::printf("bridge ok: 6x2 coords, group separation %.4f vs %.4f\n", lo,
+              hi);
+  return 0;
+}

@@ -146,7 +146,9 @@ def read_from_record(rec: dict) -> Read:
             position=rec["position"],
             read_group_set_id=rec.get("read_group_set_id", ""),
             reference_name=rec["reference_name"],
-            info={k: tuple(v) for k, v in rec.get("info", {}).items()},
+            # Same info-value shape as Read.build (plain parsed lists), so
+            # HTTP-fetched and locally-read records stay field-identical.
+            info=dict(rec.get("info", {})),
             fragment_length=rec.get("fragment_length", 0),
         )
     return Read.build(

@@ -108,3 +108,40 @@ def test_external_driver_example_script(tmp_path):
         assert len(lines) == 8  # one coordinate row per sample
     finally:
         server.stop()
+
+
+def test_bridge_from_cpp_client(tmp_path):
+    """Cross the seam from a FOREIGN runtime: a C++ TCP client speaks the
+    newline-JSON protocol against a live server — the reference's
+    JVM-driver-delegates-dense-math role (variants_pca.py:162-182) without
+    any Python on the client side."""
+    import os
+    import shutil
+    import subprocess
+
+    gxx = shutil.which("g++")
+    if gxx is None:
+        import pytest
+
+        pytest.skip("g++ not available")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(root, "examples", "pca_bridge_client.cpp")
+    binary = tmp_path / "pca_bridge_client"
+    subprocess.run(
+        [gxx, "-O2", "-std=c++17", "-o", str(binary), src],
+        check=True,
+        capture_output=True,
+        timeout=120,
+    )
+    server = PcaBridgeServer(TpuPcaBackend(block_variants=16)).start()
+    try:
+        out = subprocess.run(
+            [str(binary), str(server.port)],
+            capture_output=True,
+            timeout=120,
+            text=True,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "bridge ok" in out.stdout
+    finally:
+        server.stop()

@@ -27,11 +27,13 @@ REFS = "17:41196311:41277499"
 @pytest.fixture()
 def served_cohort():
     src = synthetic_cohort(8, 60, seed=9)
-    src.add_reads(
-        synthetic_reads(
-            20, references="17:41200000:41210000", seed=9
-        ).reads_records()
-    )
+    reads = synthetic_reads(
+        20, references="17:41200000:41210000", seed=9
+    ).reads_records()
+    # One record with an info map: HTTP and local reads must agree on the
+    # info value shape too, not just the scalar fields.
+    reads[0]["info"] = {"XT": ["U"], "NM": [0, 1]}
+    src.add_reads(reads)
     server = GenomicsServiceServer(src).start()
     try:
         yield src, HttpVariantSource(f"http://127.0.0.1:{server.port}")
