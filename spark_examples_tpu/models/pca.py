@@ -26,6 +26,7 @@ name; ``--output-path`` writes ``<path>-pca.tsv`` lines
 from __future__ import annotations
 
 import os
+import sys
 from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -257,12 +258,7 @@ class VariantsPcaDriver:
             "checkpointed ingest supports a single variantset"
         )
         if self._mesh_spans_processes():
-            raise NotImplementedError(
-                "checkpointed ingest composes with host-local meshes and "
-                "DP across hosts, not the global-mesh (pod) path: pod "
-                "blocks are collective per step, so a per-host cursor "
-                "cannot resume them independently"
-            )
+            return self._checkpointed_pod()
         vsid = self.conf.variant_set_ids[0]
         shards = self._host_shards(
             self.conf.shards(
@@ -299,18 +295,7 @@ class VariantsPcaDriver:
         every = max(1, self.conf.checkpoint_every)
         while done < len(shards):
             group = shards[done : done + every]
-
-            def group_calls():
-                for shard in group:
-                    stream = self.filter_dataset(
-                        self.source.stream_variants(vsid, shard)
-                    )
-                    yield from calls_stream([stream], self.index.indexes)
-
-            blocks = blocks_from_calls(
-                group_calls(), n, self.conf.block_variants
-            )
-            g = self._blocks_to_gramian(blocks, g_init=g)
+            g = self._ingest_shard_group(vsid, group, g)
             done += len(group)
             save_snapshot(checkpoint_dir, g, done, digest)
         if g is None:
@@ -322,6 +307,113 @@ class VariantsPcaDriver:
 
             g = allreduce_gramian(jax.numpy.asarray(g))
         return g
+
+    def _checkpointed_pod(self):
+        """Pod-mode checkpointing: a globally-synced round cursor.
+
+        Pod block steps are collective, so a per-host cursor cannot resume
+        hosts independently — instead every process runs the same number
+        of ROUNDS (checkpoint_every shards of its own manifest slice per
+        round, zero-filling when its slice runs short), and after each
+        collective round the replicated G is snapshotted by every host
+        into its own directory with the same global round cursor. Resume
+        requires all hosts to agree on the round (verified by allgather);
+        disagreement — a crash landing between two hosts' saves — discards
+        the snapshots with a warning rather than resuming inconsistently.
+
+        The sample-sharded pod regime is excluded: snapshotting a
+        cross-process-sharded G would mean gathering tens of GB per round.
+        Run the stress config without --checkpoint-dir, or checkpoint with
+        the replicated-G layout.
+        """
+        from jax.experimental import multihost_utils
+
+        from spark_examples_tpu.genomics.shards import manifest_digest
+        from spark_examples_tpu.utils.checkpoint import (
+            load_snapshot,
+            save_snapshot,
+        )
+
+        if self._sample_sharded():
+            raise ValueError(
+                "checkpointed ingest cannot snapshot a cross-process-"
+                "sharded G (gathering it per round defeats the layout); "
+                "use --no-sample-sharded or drop --checkpoint-dir"
+            )
+        vsid = self.conf.variant_set_ids[0]
+        mine = self._host_shards(
+            self.conf.shards(
+                all_references=self.conf.all_references,
+                sex_filter=SexChromosomeFilter.EXCLUDE_XY,
+            )
+        )
+        every = max(1, self.conf.checkpoint_every)
+        lens = np.asarray(
+            multihost_utils.process_allgather(
+                np.array([len(mine)], np.int64)
+            )
+        ).ravel()
+        total_rounds = int(-(-int(lens.max()) // every))  # ceil
+        checkpoint_dir = os.path.join(
+            self.conf.checkpoint_dir, f"host-{jax.process_index()}"
+        )
+        # The digest pins THIS HOST's manifest slice plus its pod-grid
+        # coordinates and round width; cross-host schedule consistency is
+        # NOT the digest's job — the rounds-allgather below enforces it.
+        digest = (
+            f"{manifest_digest(mine)}|{vsid}"
+            f"|af={self.conf.min_allele_frequency}"
+            f"|pod={jax.process_index()}/{jax.process_count()}|every={every}"
+        )
+        n = self.index.size
+        ck = load_snapshot(checkpoint_dir, digest, n)
+        local_round = ck.shards_done if ck else 0  # cursor counts ROUNDS
+        rounds = np.asarray(
+            multihost_utils.process_allgather(
+                np.array([local_round], np.int64)
+            )
+        ).ravel()
+        start = int(rounds.min())
+        if int(rounds.max()) != start:
+            print(
+                "WARNING: pod snapshot rounds disagree across hosts "
+                f"({sorted(int(r) for r in rounds)}); discarding and "
+                "re-ingesting from round 0.",
+                file=sys.stderr,
+            )
+            start, ck = 0, None
+        g = ck.g if ck else None
+        if start:
+            print(
+                f"Resuming pod ingest from round {start}/{total_rounds}."
+            )
+        for r in range(start, total_rounds):
+            # Collective round: a host whose slice ran short contributes
+            # zero-filled steps via the synced stream inside the pod
+            # accumulator, so every process executes the same collectives.
+            g = self._ingest_shard_group(
+                vsid, mine[r * every : (r + 1) * every], g
+            )
+            save_snapshot(checkpoint_dir, np.asarray(g), r + 1, digest)
+        if g is None:
+            g = self._blocks_to_gramian(iter(()))
+        return g
+
+    def _ingest_shard_group(self, vsid: str, group, g):
+        """Stream one shard group through filter → calls → Gramian blocks,
+        accumulating onto g (shared by both checkpointed ingest modes)."""
+
+        def group_calls():
+            for shard in group:
+                stream = self.filter_dataset(
+                    self.source.stream_variants(vsid, shard)
+                )
+                yield from calls_stream([stream], self.index.indexes)
+
+        blocks = blocks_from_calls(
+            group_calls(), self.index.size, self.conf.block_variants
+        )
+        return self._blocks_to_gramian(blocks, g_init=g)
 
     # -- stage 5: eigendecomposition ----------------------------------------
 

@@ -297,6 +297,141 @@ def test_global_mesh_gramian_two_processes(tmp_path):
     )
 
 
+_POD_CHECKPOINT_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from spark_examples_tpu.parallel.distributed import initialize_from_env
+    assert initialize_from_env()
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.genomics.shards import shards_for_references
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    pid = jax.process_index()
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "model"))
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,
+        block_variants=32,
+        checkpoint_dir=sys.argv[2],
+        checkpoint_every=1,
+        sample_sharded=False,
+    )
+    source = synthetic_cohort(10, 80, seed=5)
+    phase = sys.argv[3]
+    driver = VariantsPcaDriver(conf, source, mesh=mesh)
+    assert driver._mesh_spans_processes()
+    if phase == "fail":
+        # EVERY host's second-round shard fails, so both processes raise
+        # before entering that round's collectives (round 1 is already
+        # snapshotted on both).
+        shards = shards_for_references(conf.references, 20_000)
+        mine = shards[pid::2]
+        source._fail_once.add(mine[1])
+        try:
+            driver.get_similarity_matrix_checkpointed()
+            ok = False
+        except IOError:
+            ok = True
+        with open(sys.argv[1] + f".phase1.{pid}", "w") as f:
+            json.dump({"ok": ok}, f)
+    else:
+        g = np.asarray(driver.get_similarity_matrix_checkpointed())
+        if pid == 0:
+            with open(sys.argv[1], "w") as f:
+                json.dump(
+                    {"g": g.tolist(),
+                     "partitions": source.stats.partitions}, f
+                )
+    """
+)
+
+
+def test_pod_checkpoint_resume(tmp_path):
+    """Pod-mode checkpoint/resume: globally-synced round cursor over a
+    2-process global mesh; a mid-run failure on every host resumes from
+    the last collective round and matches the single-process Gramian."""
+    script = tmp_path / "worker.py"
+    script.write_text(_POD_CHECKPOINT_WORKER)
+    out_file = tmp_path / "result.json"
+    ck_dir = tmp_path / "ck"
+
+    def run_phase(phase):
+        port = _free_port()
+        env = {
+            **os.environ,
+            "PYTHONPATH": os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            ),
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": "2",
+        }
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(out_file), str(ck_dir), phase],
+                env={
+                    **env,
+                    "JAX_PROCESS_ID": str(i),
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                },
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+            for i in range(2)
+        ]
+        try:
+            logs = [p.communicate(timeout=240)[0].decode() for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        return procs, logs
+
+    procs, logs = run_phase("fail")
+    for i in range(2):
+        marker = json.loads((tmp_path / f"result.json.phase1.{i}").read_text())
+        assert marker["ok"], logs[i][-2000:]
+    assert (ck_dir / "host-0").exists() and (ck_dir / "host-1").exists()
+
+    procs, logs = run_phase("resume")
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, log[-2000:]
+    result = json.loads(out_file.read_text())
+    # Round 1 resumed from its snapshot: the rerun re-streamed fewer
+    # shards than the full manifest slice.
+    assert result["partitions"] < 3
+
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    plain = VariantsPcaDriver(
+        PcaConfig(
+            variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+            bases_per_partition=20_000,
+            block_variants=32,
+        ),
+        synthetic_cohort(10, 80, seed=5),
+    )
+    data = plain.get_data()
+    calls = plain.get_calls([plain.filter_dataset(d) for d in data])
+    g_plain = np.asarray(plain.get_similarity_matrix(calls))
+    np.testing.assert_array_equal(np.asarray(result["g"]), g_plain)
+
+
 _SAMPLE_SHARDED_WORKER = textwrap.dedent(
     """
     import json, os, sys
