@@ -80,6 +80,8 @@ def synthetic_cohort(
         )
         for i in range(n_samples)
     ]
+    ids = [c.id for c in callsets]
+    names = [c.name for c in callsets]
     groups = rng.integers(0, population_structure, size=n_samples)
 
     # Spread variant positions across the configured regions.
@@ -120,15 +122,23 @@ def synthetic_cohort(
         group_af = rng.beta(0.4, 1.2, size=population_structure)
         carrier_p = group_af[groups]
         gts = rng.random(n_samples) < carrier_p
-        sample_range = (
-            np.nonzero(gts)[0] if sparse_calls else range(n_samples)
+        carriers = np.nonzero(gts)[0]
+        # One vectorized draw per carrier, consumed in carrier order —
+        # bit-identical to the per-carrier scalar draws this replaces
+        # (numpy Generators produce the same stream either way), so
+        # seeded cohorts (incl. the committed golden) are unchanged.
+        hom = np.zeros(n_samples, dtype=bool)
+        hom[carriers] = rng.random(len(carriers)) < 0.3
+        gts_l, hom_l = gts.tolist(), hom.tolist()
+        sample_range = carriers.tolist() if sparse_calls else range(
+            n_samples
         )
         calls = [
             {
-                "callset_id": callsets[s].id,
-                "callset_name": callsets[s].name,
-                "genotype": [1, 1] if (gts[s] and rng.random() < 0.3)
-                else ([0, 1] if gts[s] else [0, 0]),
+                "callset_id": ids[s],
+                "callset_name": names[s],
+                "genotype": [1, 1] if hom_l[s]
+                else ([0, 1] if gts_l[s] else [0, 0]),
             }
             for s in sample_range
         ]

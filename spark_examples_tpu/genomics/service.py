@@ -305,6 +305,36 @@ class HttpVariantSource:
                 f"{path}: stream aborted mid-shard (no end-of-stream frame)"
             )
 
+    def stream_carrying(
+        self,
+        variant_set_id: str,
+        shard: Shard,
+        indexes: dict,
+        min_allele_frequency=None,
+    ):
+        """Fused fast path over the wire records (see
+        sources._carrying_records); the server already applied STRICT
+        slicing, contig normalization, and the variant-set filter."""
+        from spark_examples_tpu.genomics.sources import _carrying_records
+
+        self.stats.add(partitions=1, reference_bases=shard.range)
+        resp = self._request(
+            "/variants",
+            {
+                "variant_set_id": variant_set_id,
+                "contig": shard.contig,
+                "start": shard.start,
+                "end": shard.end,
+            },
+        )
+        yield from _carrying_records(
+            (json.loads(line) for line in self._stream_lines(resp, "/variants")),
+            indexes,
+            variant_set_id,
+            self.stats,
+            min_allele_frequency,
+        )
+
     def stream_reads(
         self, read_group_set_id: str, shard: Shard
     ) -> Iterator[Read]:

@@ -82,12 +82,7 @@ class VariantsPcaDriver:
         Under multi-host each process ingests a round-robin slice of the
         manifest; partial Gramians merge in get_similarity_matrix.
         """
-        shards = self._host_shards(
-            self.conf.shards(
-                all_references=self.conf.all_references,
-                sex_filter=SexChromosomeFilter.EXCLUDE_XY,
-            )
-        )
+        shards = self._manifest()
         # When the manifest visits each contig exactly once (one contiguous
         # run — true for --all-references and any non-repeating
         # --references), multi-dataset joins may partition their state by
@@ -106,6 +101,17 @@ class VariantsPcaDriver:
         if jax.process_count() > 1:
             return shards[jax.process_index() :: jax.process_count()]
         return shards
+
+    def _manifest(self):
+        """This process's shard manifest — the ONE place the partitioner
+        parameters live, so fused/staged/checkpointed ingest can never
+        disagree on what they ingest."""
+        return self._host_shards(
+            self.conf.shards(
+                all_references=self.conf.all_references,
+                sex_filter=SexChromosomeFilter.EXCLUDE_XY,
+            )
+        )
 
     # -- stage 2: filters ----------------------------------------------------
 
@@ -128,6 +134,38 @@ class VariantsPcaDriver:
             self.index.indexes,
             contig_runs_unique=getattr(self, "_contig_runs_unique", False),
         )
+
+    def _fused_ingest_possible(self) -> bool:
+        """The fast path fuses ingest → AF filter → call extraction when
+        nothing needs full Variant/Call records: single dataset (no
+        identity join), no --debug-datasets printing, and a source that
+        implements stream_carrying."""
+        return (
+            len(self.conf.variant_set_ids) == 1
+            and not self.conf.debug_datasets
+            and hasattr(self.source, "stream_carrying")
+        )
+
+    def get_calls_fused(self) -> Iterator[List[int]]:
+        """Fused single-dataset ingest: shards → carrying index lists.
+
+        Same observable behavior as get_data → filter_dataset → get_calls
+        (verified by parity tests) minus the per-call object
+        materialization that dominates host ingest at chr20+ scale.
+        """
+        vsid = self.conf.variant_set_ids[0]
+        shards = self._manifest()
+        if self.conf.min_allele_frequency is not None:
+            print(
+                f"Min allele frequency {self.conf.min_allele_frequency}."
+            )
+        for shard in shards:
+            yield from self.source.stream_carrying(
+                vsid,
+                shard,
+                self.index.indexes,
+                self.conf.min_allele_frequency,
+            )
 
     @staticmethod
     def _debug_wrap(stream):
@@ -260,12 +298,7 @@ class VariantsPcaDriver:
         if self._mesh_spans_processes():
             return self._checkpointed_pod()
         vsid = self.conf.variant_set_ids[0]
-        shards = self._host_shards(
-            self.conf.shards(
-                all_references=self.conf.all_references,
-                sex_filter=SexChromosomeFilter.EXCLUDE_XY,
-            )
-        )
+        shards = self._manifest()
         checkpoint_dir = self.conf.checkpoint_dir
         # Multi-host: each process checkpoints ITS manifest slice into its
         # own subdirectory (no cross-host file races); partials merge
@@ -341,12 +374,7 @@ class VariantsPcaDriver:
                 "use --no-sample-sharded or drop --checkpoint-dir"
             )
         vsid = self.conf.variant_set_ids[0]
-        mine = self._host_shards(
-            self.conf.shards(
-                all_references=self.conf.all_references,
-                sex_filter=SexChromosomeFilter.EXCLUDE_XY,
-            )
-        )
+        mine = self._manifest()
         every = max(1, self.conf.checkpoint_every)
         lens = np.asarray(
             multihost_utils.process_allgather(
@@ -402,13 +430,24 @@ class VariantsPcaDriver:
     def _ingest_shard_group(self, vsid: str, group, g):
         """Stream one shard group through filter → calls → Gramian blocks,
         accumulating onto g (shared by both checkpointed ingest modes)."""
+        fused = self._fused_ingest_possible()
 
         def group_calls():
             for shard in group:
-                stream = self.filter_dataset(
-                    self.source.stream_variants(vsid, shard)
-                )
-                yield from calls_stream([stream], self.index.indexes)
+                if fused:
+                    yield from self.source.stream_carrying(
+                        vsid,
+                        shard,
+                        self.index.indexes,
+                        self.conf.min_allele_frequency,
+                    )
+                else:
+                    stream = self.filter_dataset(
+                        self.source.stream_variants(vsid, shard)
+                    )
+                    yield from calls_stream(
+                        [stream], self.index.indexes
+                    )
 
         blocks = blocks_from_calls(
             group_calls(), self.index.size, self.conf.block_variants
@@ -538,6 +577,8 @@ class VariantsPcaDriver:
                     and len(self.conf.variant_set_ids) == 1
                 ):
                     g = self.get_similarity_matrix_checkpointed()
+                elif self._fused_ingest_possible():
+                    g = self.get_similarity_matrix(self.get_calls_fused())
                 else:
                     data = self.get_data()
                     filtered = [self.filter_dataset(d) for d in data]

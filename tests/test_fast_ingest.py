@@ -1,0 +1,189 @@
+"""Fused carrying-index ingest fast path ≡ the staged slow path.
+
+The fast path (sources.stream_carrying / _carrying_records) exists because
+per-call dataclass construction dominated host ingest at chr20 scale; its
+contract is OBSERVABLE EQUALITY with stream_variants → af_filter →
+carrying_sample_indices on every source type, stats included.
+"""
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.genomics.callsets import CallsetIndex
+from spark_examples_tpu.genomics.datasets import (
+    af_filter,
+    carrying_sample_indices,
+)
+from spark_examples_tpu.genomics.fixtures import (
+    DEFAULT_VARIANT_SET_ID,
+    synthetic_cohort,
+)
+from spark_examples_tpu.genomics.shards import shards_for_references
+from spark_examples_tpu.genomics.sources import FixtureSource, JsonlSource
+from spark_examples_tpu.utils.stats import IoStats
+
+REFS = "17:41196311:41277499"
+
+
+def _slow(source, vsid, shards, indexes, min_af):
+    out = []
+    for shard in shards:
+        stream = af_filter(source.stream_variants(vsid, shard), min_af)
+        for v in stream:
+            calls = carrying_sample_indices(v, indexes)
+            if calls:
+                out.append(calls)
+    return out
+
+
+def _fast(source, vsid, shards, indexes, min_af):
+    out = []
+    for shard in shards:
+        out.extend(source.stream_carrying(vsid, shard, indexes, min_af))
+    return out
+
+
+def _cohort(**kw):
+    return synthetic_cohort(
+        12,
+        80,
+        seed=21,
+        dropped_contig_every=9,
+        reference_blocks_every=13,
+        **kw,
+    )
+
+
+@pytest.mark.parametrize("min_af", [None, 0.2])
+def test_fixture_source_parity(min_af):
+    shards = shards_for_references(REFS, 20_000)
+    slow_src, fast_src = _cohort(), _cohort()
+    index = CallsetIndex.from_source(slow_src, [DEFAULT_VARIANT_SET_ID])
+    slow = _slow(
+        slow_src, DEFAULT_VARIANT_SET_ID, shards, index.indexes, min_af
+    )
+    fast = _fast(
+        fast_src, DEFAULT_VARIANT_SET_ID, shards, index.indexes, min_af
+    )
+    assert fast == slow
+    # Stats parity: same variants_read (counted post contig-drop,
+    # pre AF-filter) and same request/partition accounting.
+    assert fast_src.stats.variants_read == slow_src.stats.variants_read
+    assert fast_src.stats.partitions == slow_src.stats.partitions
+
+
+@pytest.mark.parametrize("min_af", [None, 0.2])
+def test_jsonl_source_parity(tmp_path, min_af):
+    _cohort().dump(str(tmp_path / "c"))
+    shards = shards_for_references(REFS, 20_000)
+    slow_src = JsonlSource(str(tmp_path / "c"))
+    fast_src = JsonlSource(str(tmp_path / "c"))
+    index = CallsetIndex.from_source(slow_src, [DEFAULT_VARIANT_SET_ID])
+    assert _fast(
+        fast_src, DEFAULT_VARIANT_SET_ID, shards, index.indexes, min_af
+    ) == _slow(
+        slow_src, DEFAULT_VARIANT_SET_ID, shards, index.indexes, min_af
+    )
+    assert fast_src.stats.variants_read == slow_src.stats.variants_read
+
+
+def test_http_source_parity():
+    from spark_examples_tpu.genomics.service import (
+        GenomicsServiceServer,
+        HttpVariantSource,
+    )
+
+    server = GenomicsServiceServer(_cohort()).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        shards = shards_for_references(REFS, 20_000)
+        slow_src = HttpVariantSource(url)
+        fast_src = HttpVariantSource(url)
+        index = CallsetIndex.from_source(
+            slow_src, [DEFAULT_VARIANT_SET_ID]
+        )
+        assert _fast(
+            fast_src, DEFAULT_VARIANT_SET_ID, shards, index.indexes, 0.2
+        ) == _slow(
+            slow_src, DEFAULT_VARIANT_SET_ID, shards, index.indexes, 0.2
+        )
+    finally:
+        server.stop()
+
+
+def test_variant_object_fallback_parity():
+    # A fixture holding built Variant objects takes the order-preserving
+    # fallback; results must still match the staged path.
+    raw = _cohort()
+    from spark_examples_tpu.genomics.sources import variant_from_record
+
+    objs = [
+        v
+        for rec in raw._variants
+        if (v := variant_from_record(rec)) is not None
+    ]
+    obj_src = FixtureSource(variants=objs, callsets=raw._callsets)
+    ref_src = _cohort()
+    shards = shards_for_references(REFS, 20_000)
+    index = CallsetIndex.from_source(ref_src, [DEFAULT_VARIANT_SET_ID])
+    assert _fast(
+        obj_src, DEFAULT_VARIANT_SET_ID, shards, index.indexes, 0.2
+    ) == _slow(
+        ref_src, DEFAULT_VARIANT_SET_ID, shards, index.indexes, 0.2
+    )
+
+
+def test_unknown_callset_raises_keyerror():
+    src = _cohort()
+    shards = shards_for_references(REFS, 100_000)
+    with pytest.raises(KeyError):
+        for _ in src.stream_carrying(
+            DEFAULT_VARIANT_SET_ID, shards[0], {"not-a-callset": 0}
+        ):
+            pass
+
+
+def test_fault_injection_fires_in_fast_path():
+    src = _cohort()
+    shard = shards_for_references(REFS, 100_000)[0]
+    src._fail_once.add(shard)
+    with pytest.raises(IOError):
+        list(src.stream_carrying(DEFAULT_VARIANT_SET_ID, shard, {}))
+    assert src.stats.io_exceptions == 1
+
+
+def test_driver_fused_equals_staged():
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    class StagedOnly:
+        """Proxy hiding stream_carrying so the driver takes the slow path."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.stats = inner.stats
+
+        def list_callsets(self, vsid):
+            return self._inner.list_callsets(vsid)
+
+        def stream_variants(self, vsid, shard):
+            return self._inner.stream_variants(vsid, shard)
+
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,
+        block_variants=32,
+        min_allele_frequency=0.1,
+    )
+    fused_driver = VariantsPcaDriver(conf, _cohort())
+    assert fused_driver._fused_ingest_possible()
+    fused = fused_driver.run()
+    staged_driver = VariantsPcaDriver(conf, StagedOnly(_cohort()))
+    assert not staged_driver._fused_ingest_possible()
+    staged = staged_driver.run()
+    assert [r[0] for r in fused] == [r[0] for r in staged]
+    np.testing.assert_allclose(
+        np.array([r[1:] for r in fused]),
+        np.array([r[1:] for r in staged]),
+        atol=1e-6,
+    )
