@@ -62,6 +62,13 @@ def _offline_source(args, references: str):
     if args.input_path:
         return JsonlSource(args.input_path)
     if args.fixture_samples:
+        if getattr(args, "all_references", False):
+            # Cover exactly what the --all-references manifest queries.
+            from spark_examples_tpu.genomics.shards import (
+                references_for_all,
+            )
+
+            references = references_for_all()
         return synthetic_cohort(
             args.fixture_samples,
             args.fixture_variants,

@@ -34,6 +34,8 @@ __all__ = [
     "parse_references",
     "shards_for_references",
     "shards_for_all_references",
+    "chromosomes_for_filter",
+    "references_for_all",
     "manifest_digest",
     "DEFAULT_BASES_PER_SHARD",
     "BRCA1_REFERENCES",
@@ -136,6 +138,37 @@ def shards_for_references(
     return shards
 
 
+def chromosomes_for_filter(
+    sex_filter: SexChromosomeFilter = SexChromosomeFilter.EXCLUDE_XY,
+    chromosomes: Dict[str, int] = None,
+) -> Dict[str, int]:
+    """The chromosome table after the sex filter — the ONE place the
+    EXCLUDE_XY policy lives (VariantsRDD.scala:275 vs ReadsRDD.scala:165)."""
+    chromosomes = chromosomes or HUMAN_CHROMOSOMES
+    if sex_filter is not SexChromosomeFilter.EXCLUDE_XY:
+        return dict(chromosomes)
+    return {
+        c: length
+        for c, length in chromosomes.items()
+        if c not in ("X", "Y")
+    }
+
+
+def references_for_all(
+    sex_filter: SexChromosomeFilter = SexChromosomeFilter.EXCLUDE_XY,
+    chromosomes: Dict[str, int] = None,
+) -> str:
+    """All covered chromosomes as a ``--references`` string (whole-length
+    regions) — so cohort generators can target exactly what an
+    --all-references manifest queries."""
+    return ",".join(
+        f"{c}:0:{length}"
+        for c, length in chromosomes_for_filter(
+            sex_filter, chromosomes
+        ).items()
+    )
+
+
 def shards_for_all_references(
     sex_filter: SexChromosomeFilter = SexChromosomeFilter.EXCLUDE_XY,
     bases_per_shard: int = DEFAULT_BASES_PER_SHARD,
@@ -143,14 +176,10 @@ def shards_for_all_references(
 ) -> List[Shard]:
     """Cover every chromosome — AllReferences{Variants,Reads}Partitioner
     (VariantsRDD.scala:266-280, ReadsRDD.scala:158-170)."""
-    chromosomes = chromosomes or HUMAN_CHROMOSOMES
     shards = []
-    for contig, length in chromosomes.items():
-        if (
-            sex_filter is SexChromosomeFilter.EXCLUDE_XY
-            and contig in ("X", "Y")
-        ):
-            continue
+    for contig, length in chromosomes_for_filter(
+        sex_filter, chromosomes
+    ).items():
         shards.extend(_window(contig, 0, length, bases_per_shard))
     return shards
 

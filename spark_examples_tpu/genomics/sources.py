@@ -29,6 +29,8 @@ import os
 from dataclasses import dataclass
 from typing import Iterable, Iterator, List, Optional, Protocol, Sequence
 
+import numpy as np
+
 from spark_examples_tpu.genomics.shards import Shard
 from spark_examples_tpu.genomics.types import Call, Read, Variant
 from spark_examples_tpu.utils.stats import IoStats
@@ -474,6 +476,215 @@ class FixtureSource:
                     f.write(json.dumps(rec) + "\n")
 
 
+class _CsrCohort:
+    """Columnar CSR sidecar for a JSONL cohort — parse once, mmap forever.
+
+    Repeat runs over an on-disk cohort re-parsed the whole JSONL every
+    time (json.loads per record dominates at chr20+ scale). The sidecar
+    persists the carrying representation in numpy arrays keyed by the
+    source files' (size, mtime) so any edit invalidates it:
+
+    - per contig-kept variant (normalize_contig ≠ None): contig code,
+      start, variant-set code, AF (NaN = absent) — everything the fused
+      fast path filters on;
+    - CSR call arrays whose values are CALLSET ORDINALS in callsets.json
+      file order, remapped to the run's dense sample indexes at query
+      time (the dense index is config-dependent; the file order is not).
+
+    Serves ONLY ``stream_carrying`` — full-record streaming still parses
+    (those consumers need fields the sidecar doesn't keep).
+    """
+
+    VERSION = 1
+
+    def __init__(self, data: dict):
+        self._d = data
+        # contig → (lo, hi) row range; starts sorted within each range.
+        self.segments = {
+            c: (int(lo), int(hi))
+            for c, lo, hi in zip(
+                data["contigs"].tolist(),
+                data["seg_lo"].tolist(),
+                data["seg_hi"].tolist(),
+            )
+        }
+        # Per-query caches: the ordinal→dense-index lookup and the
+        # variant-set mask are identical across a manifest's thousands of
+        # shard queries. Holding the indexes dict itself (not its id)
+        # makes the identity check safe against id reuse.
+        self._lookup_indexes = None
+        self._lookup = None
+        self._allowed_vsid = None
+        self._allowed = None
+
+    @staticmethod
+    def _digest(paths) -> str:
+        parts = [f"v{_CsrCohort.VERSION}"]
+        for p in paths:
+            st = os.stat(p)
+            parts.append(f"{os.path.basename(p)}:{st.st_size}:{st.st_mtime_ns}")
+        return "|".join(parts)
+
+    @classmethod
+    def load_or_build(cls, root: str, open_fn) -> "_CsrCohort":
+        from spark_examples_tpu.genomics.types import normalize_contig
+
+        sidecar = os.path.join(root, ".variants.csr.npz")
+        src_paths = []
+        for name in ("variants.jsonl", "callsets.json"):
+            p = os.path.join(root, name)
+            src_paths.append(p + ".gz" if os.path.exists(p + ".gz") else p)
+        digest = cls._digest(src_paths)
+        if os.path.exists(sidecar):
+            import zipfile
+
+            try:
+                data = dict(np.load(sidecar, allow_pickle=False))
+                if str(data["digest"]) == digest:
+                    return cls(data)
+            except (
+                OSError,
+                ValueError,
+                KeyError,
+                EOFError,
+                zipfile.BadZipFile,
+            ):
+                pass  # unreadable/corrupt/stale → rebuild
+
+        # One full parse → columnar arrays, grouped by contig, starts
+        # sorted within each contig (the _SortedIndex ordering).
+        with open_fn("callsets.json") as f:
+            callset_ids = [r["id"] for r in json.load(f)]
+        ord_of = {cid: i for i, cid in enumerate(callset_ids)}
+        by_contig: dict = {}
+        with open_fn("variants.jsonl") as f:
+            for line in f:
+                rec = json.loads(line)
+                contig = normalize_contig(rec["reference_name"])
+                if contig is None:
+                    continue
+                af = (rec.get("info") or {}).get("AF")
+                # Non-numeric AF (e.g. the VCF "." missing marker) stores
+                # as NaN: with the filter OFF this matches the staged path
+                # (AF untouched); with it ON the record drops where the
+                # staged float() would raise — strictly more tolerant,
+                # never silently keeps.
+                try:
+                    af_val = float(af[0]) if af else np.nan
+                except (TypeError, ValueError):
+                    af_val = np.nan
+                ords = [
+                    ord_of[c["callset_id"]]
+                    for c in rec.get("calls", ())
+                    if any(g > 0 for g in c.get("genotype", ()))
+                ]
+                by_contig.setdefault(contig, []).append(
+                    (
+                        int(rec["start"]),
+                        rec.get("variant_set_id", ""),
+                        af_val,
+                        ords,
+                    )
+                )
+        contigs = sorted(by_contig)
+        vsids: List[str] = []
+        vsid_of = {}
+        starts, vcode, afs, offs, ords_flat = [], [], [], [0], []
+        seg_lo, seg_hi = [], []
+        for contig in contigs:
+            rows = sorted(by_contig[contig], key=lambda r: r[0])
+            seg_lo.append(len(starts))
+            for start, vsid, af, ords in rows:
+                if vsid not in vsid_of:
+                    vsid_of[vsid] = len(vsids)
+                    vsids.append(vsid)
+                starts.append(start)
+                vcode.append(vsid_of[vsid])
+                afs.append(af)
+                ords_flat.extend(ords)
+                offs.append(len(ords_flat))
+            seg_hi.append(len(starts))
+        def str_arr(values):
+            # Inferred itemsize: a fixed "U<n>" would silently truncate
+            # longer (e.g. URI-style) ids.
+            return np.array(values, dtype=str if values else "U1")
+
+        data = {
+            "digest": np.str_(digest),
+            "contigs": str_arr(contigs),
+            "seg_lo": np.array(seg_lo, dtype=np.int64),
+            "seg_hi": np.array(seg_hi, dtype=np.int64),
+            "starts": np.array(starts, dtype=np.int64),
+            "vcode": np.array(vcode, dtype=np.int32),
+            "afs": np.array(afs, dtype=np.float64),
+            "offsets": np.array(offs, dtype=np.int64),
+            "ords": np.array(ords_flat, dtype=np.int32),
+            "vsids": str_arr(vsids),
+            "callset_ids": str_arr(callset_ids),
+        }
+        tmp = f"{sidecar}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, **data)
+            os.replace(tmp, sidecar)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass  # read-only cohort dir: serve from memory, no cache
+        return cls(data)
+
+    def carrying(self, shard, indexes, variant_set_id, stats, min_af):
+        """Per-variant carrying index lists for the shard — semantics of
+        :func:`_carrying_records` over the columnar arrays."""
+        d = self._d
+        seg = self.segments.get(_strip_chr(shard.contig))
+        if seg is None:
+            return
+        lo, hi = seg
+        starts = d["starts"]
+        a = lo + int(np.searchsorted(starts[lo:hi], shard.start, "left"))
+        b = lo + int(np.searchsorted(starts[lo:hi], shard.end, "left"))
+        if a == b:
+            return
+        keep = np.ones(b - a, dtype=bool)
+        if variant_set_id:
+            if self._allowed_vsid != variant_set_id:
+                self._allowed = np.array(
+                    [
+                        (not v) or v == variant_set_id
+                        for v in d["vsids"].tolist()
+                    ]
+                )
+                self._allowed_vsid = variant_set_id
+            keep &= self._allowed[d["vcode"][a:b]]
+        stats.add(variants_read=int(keep.sum()))
+        if min_af is not None:
+            afs = d["afs"][a:b]
+            with np.errstate(invalid="ignore"):
+                keep &= afs >= min_af  # NaN compares False → dropped
+        # Callset-ordinal → dense-index lookup; unknown ids must raise
+        # KeyError exactly like the dict path (mapping(callsetId) throws).
+        if self._lookup_indexes is not indexes:
+            lookup = np.full(len(d["callset_ids"]), -1, dtype=np.int64)
+            for i, cid in enumerate(d["callset_ids"].tolist()):
+                if cid in indexes:
+                    lookup[i] = indexes[cid]
+            self._lookup, self._lookup_indexes = lookup, indexes
+        lookup = self._lookup
+        offsets = d["offsets"]
+        ords = d["ords"]
+        for row in np.nonzero(keep)[0].tolist():
+            o_lo, o_hi = offsets[a + row], offsets[a + row + 1]
+            if o_lo == o_hi:
+                continue
+            mapped = lookup[ords[o_lo:o_hi]]
+            if (mapped < 0).any():
+                bad = int(ords[o_lo:o_hi][mapped < 0][0])
+                raise KeyError(str(d["callset_ids"][bad]))
+            yield mapped.tolist()
+
+
 class JsonlSource:
     """Newline-JSON cohort on disk: ``<dir>/callsets.json`` +
     ``<dir>/variants.jsonl[.gz]`` (+ optional ``reads.jsonl[.gz]``).
@@ -486,6 +697,7 @@ class JsonlSource:
     def __init__(self, root: str, stats: Optional[IoStats] = None):
         self.root = root
         self.stats = stats if stats is not None else IoStats()
+        self._csr: Optional[_CsrCohort] = None
         # Parsed-record index: a manifest has O(thousands) of shards
         # (--all-references at 1M bases/shard ≈ 2,900), so re-reading —
         # or even re-scanning — the whole file once per shard would make
@@ -554,11 +766,14 @@ class JsonlSource:
         indexes: dict,
         min_allele_frequency: Optional[float] = None,
     ) -> Iterator[List[int]]:
-        """Fused fast path over the parsed-record index (see
-        :func:`_carrying_records`)."""
+        """Fused fast path over the persistent columnar sidecar (built on
+        first use, reused across shards, runs, and processes — see
+        :class:`_CsrCohort`)."""
         self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
-        yield from _carrying_records(
-            self._variants_index().slice(shard),
+        if self._csr is None:
+            self._csr = _CsrCohort.load_or_build(self.root, self._open)
+        yield from self._csr.carrying(
+            shard,
             indexes,
             variant_set_id,
             self.stats,

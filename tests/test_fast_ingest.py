@@ -187,3 +187,66 @@ def test_driver_fused_equals_staged():
         np.array([r[1:] for r in staged]),
         atol=1e-6,
     )
+
+
+class TestCsrSidecar:
+    def test_sidecar_persists_and_serves_without_reparse(self, tmp_path):
+        import os
+
+        root = str(tmp_path / "c")
+        _cohort().dump(root)
+        shards = shards_for_references(REFS, 20_000)
+        first = JsonlSource(root)
+        index = CallsetIndex.from_source(first, [DEFAULT_VARIANT_SET_ID])
+        want = _fast(first, DEFAULT_VARIANT_SET_ID, shards, index.indexes, None)
+        sidecar = os.path.join(root, ".variants.csr.npz")
+        assert os.path.exists(sidecar)
+
+        # Corrupt the JSONL but keep its stat signature: a fresh source
+        # must serve identical results purely from the sidecar — proof it
+        # never re-parses.
+        path = os.path.join(root, "variants.jsonl")
+        st = os.stat(path)
+        size = st.st_size
+        with open(path, "r+b") as f:
+            f.write(b"\x00" * min(64, size))
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns))
+        assert os.stat(path).st_size == size
+        fresh = JsonlSource(root)
+        got = _fast(fresh, DEFAULT_VARIANT_SET_ID, shards, index.indexes, None)
+        assert got == want
+
+    def test_sidecar_invalidated_by_file_change(self, tmp_path):
+        import json as _json
+        import os
+
+        root = str(tmp_path / "c")
+        _cohort().dump(root)
+        shards = shards_for_references(REFS, 100_000)
+        first = JsonlSource(root)
+        index = CallsetIndex.from_source(first, [DEFAULT_VARIANT_SET_ID])
+        before = _fast(
+            first, DEFAULT_VARIANT_SET_ID, shards, index.indexes, None
+        )
+        # Append one more carrying variant; mtime/size change → rebuild.
+        rec = {
+            "reference_name": "17",
+            "start": 41200001,
+            "end": 41200002,
+            "reference_bases": "A",
+            "alternate_bases": ["G"],
+            "variant_set_id": DEFAULT_VARIANT_SET_ID,
+            "calls": [
+                {
+                    "callset_id": f"{DEFAULT_VARIANT_SET_ID}-0",
+                    "genotype": [0, 1],
+                }
+            ],
+        }
+        with open(os.path.join(root, "variants.jsonl"), "a") as f:
+            f.write(_json.dumps(rec) + "\n")
+        fresh = JsonlSource(root)
+        after = _fast(
+            fresh, DEFAULT_VARIANT_SET_ID, shards, index.indexes, None
+        )
+        assert len(after) == len(before) + 1
