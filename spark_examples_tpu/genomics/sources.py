@@ -527,8 +527,6 @@ class _CsrCohort:
 
     @classmethod
     def load_or_build(cls, root: str, open_fn) -> "_CsrCohort":
-        from spark_examples_tpu.genomics.types import normalize_contig
-
         sidecar = os.path.join(root, ".variants.csr.npz")
         src_paths = []
         for name in ("variants.jsonl", "callsets.json"):
@@ -551,77 +549,15 @@ class _CsrCohort:
             ):
                 pass  # unreadable/corrupt/stale → rebuild
 
-        # One full parse → columnar arrays, grouped by contig, starts
-        # sorted within each contig (the _SortedIndex ordering).
+        # One full parse (native C++ when possible, Python otherwise) to
+        # FILE-ORDERED columnar arrays, then one shared vectorized
+        # assembly into the per-contig sorted layout.
         with open_fn("callsets.json") as f:
             callset_ids = [r["id"] for r in json.load(f)]
-        ord_of = {cid: i for i, cid in enumerate(callset_ids)}
-        by_contig: dict = {}
-        with open_fn("variants.jsonl") as f:
-            for line in f:
-                rec = json.loads(line)
-                contig = normalize_contig(rec["reference_name"])
-                if contig is None:
-                    continue
-                af = (rec.get("info") or {}).get("AF")
-                # Non-numeric AF (e.g. the VCF "." missing marker) stores
-                # as NaN: with the filter OFF this matches the staged path
-                # (AF untouched); with it ON the record drops where the
-                # staged float() would raise — strictly more tolerant,
-                # never silently keeps.
-                try:
-                    af_val = float(af[0]) if af else np.nan
-                except (TypeError, ValueError):
-                    af_val = np.nan
-                ords = [
-                    ord_of[c["callset_id"]]
-                    for c in rec.get("calls", ())
-                    if any(g > 0 for g in c.get("genotype", ()))
-                ]
-                by_contig.setdefault(contig, []).append(
-                    (
-                        int(rec["start"]),
-                        rec.get("variant_set_id", ""),
-                        af_val,
-                        ords,
-                    )
-                )
-        contigs = sorted(by_contig)
-        vsids: List[str] = []
-        vsid_of = {}
-        starts, vcode, afs, offs, ords_flat = [], [], [], [0], []
-        seg_lo, seg_hi = [], []
-        for contig in contigs:
-            rows = sorted(by_contig[contig], key=lambda r: r[0])
-            seg_lo.append(len(starts))
-            for start, vsid, af, ords in rows:
-                if vsid not in vsid_of:
-                    vsid_of[vsid] = len(vsids)
-                    vsids.append(vsid)
-                starts.append(start)
-                vcode.append(vsid_of[vsid])
-                afs.append(af)
-                ords_flat.extend(ords)
-                offs.append(len(ords_flat))
-            seg_hi.append(len(starts))
-        def str_arr(values):
-            # Inferred itemsize: a fixed "U<n>" would silently truncate
-            # longer (e.g. URI-style) ids.
-            return np.array(values, dtype=str if values else "U1")
-
-        data = {
-            "digest": np.str_(digest),
-            "contigs": str_arr(contigs),
-            "seg_lo": np.array(seg_lo, dtype=np.int64),
-            "seg_hi": np.array(seg_hi, dtype=np.int64),
-            "starts": np.array(starts, dtype=np.int64),
-            "vcode": np.array(vcode, dtype=np.int32),
-            "afs": np.array(afs, dtype=np.float64),
-            "offsets": np.array(offs, dtype=np.int64),
-            "ords": np.array(ords_flat, dtype=np.int32),
-            "vsids": str_arr(vsids),
-            "callset_ids": str_arr(callset_ids),
-        }
+        parsed = cls._parse_native(root, callset_ids)
+        if parsed is None:
+            parsed = cls._parse_python(open_fn, callset_ids)
+        data = cls._assemble(digest, callset_ids, *parsed)
         tmp = f"{sidecar}.{os.getpid()}.tmp"
         try:
             with open(tmp, "wb") as f:
@@ -633,6 +569,215 @@ class _CsrCohort:
             except OSError:
                 pass  # read-only cohort dir: serve from memory, no cache
         return cls(data)
+
+    @staticmethod
+    def _parse_native(root: str, callset_ids):
+        """C++ parse of an uncompressed variants.jsonl, or None to fall
+        back (gz input, no toolchain, or any parse anomaly — the native
+        parser handles the interchange schema and refuses everything
+        else, so it is fast without ever being wrong)."""
+        import ctypes
+
+        from spark_examples_tpu.native import load
+
+        path = os.path.join(root, "variants.jsonl")
+        # Mirror _open()'s preference: when a .gz exists it is the
+        # authoritative file, and the native parser doesn't decompress.
+        if os.path.exists(path + ".gz") or not os.path.exists(path):
+            return None
+        lib = load()
+        if lib is None or not hasattr(lib, "parse_cohort_jsonl"):
+            return None
+        encoded = [cid.encode() for cid in callset_ids]
+        blob = b"".join(encoded)
+        offs = np.zeros(len(callset_ids) + 1, dtype=np.int64)
+        if encoded:
+            np.cumsum([len(e) for e in encoded], out=offs[1:])
+        res = lib.parse_cohort_jsonl(
+            path.encode(), blob, offs.ctypes.data, len(callset_ids)
+        )
+        try:
+            c = res.contents
+            if c.error != 0:
+                return None
+            nv, nc = c.n_variants, c.n_calls
+
+            def arr(ptr, n, dtype):
+                if n == 0:
+                    return np.zeros(0, dtype=dtype)
+                return np.ctypeslib.as_array(ptr, shape=(int(n),)).astype(
+                    dtype, copy=True
+                )
+
+            def table(blob_ptr, offs_ptr, n):
+                if n == 0:
+                    return []
+                ends = arr(offs_ptr, int(n) + 1, np.int64)
+                raw = ctypes.string_at(blob_ptr, int(ends[-1]))
+                return [
+                    raw[ends[i] : ends[i + 1]].decode()
+                    for i in range(int(n))
+                ]
+
+            return (
+                table(c.contig_blob, c.contig_offs, c.n_contigs),
+                arr(c.contig_code, nv, np.int32),
+                arr(c.starts, nv, np.int64),
+                table(c.vsid_blob, c.vsid_offs, c.n_vsids),
+                arr(c.vsid_code, nv, np.int32),
+                arr(c.afs, nv, np.float64),
+                arr(c.offsets, nv + 1, np.int64),
+                arr(c.ords, nc, np.int32),
+            )
+        finally:
+            lib.cohort_csr_free(res)
+
+    @staticmethod
+    def _parse_python(open_fn, callset_ids):
+        """Reference parse: json.loads per line -> the same file-ordered
+        arrays the native parser produces (parity-tested)."""
+        from spark_examples_tpu.genomics.types import normalize_contig
+
+        ord_of = {cid: i for i, cid in enumerate(callset_ids)}
+        contig_table: List[str] = []
+        contig_of: dict = {}
+        vsid_table: List[str] = []
+        vsid_of: dict = {}
+        rec_contig, starts, rec_vsid, afs = [], [], [], []
+        offs, ords = [0], []
+        with open_fn("variants.jsonl") as f:
+            for line in f:
+                rec = json.loads(line)
+                contig = normalize_contig(rec["reference_name"])
+                if contig is None:
+                    continue
+                af = (rec.get("info") or {}).get("AF")
+                # Non-numeric AF (e.g. the VCF "." missing marker) stores
+                # as NaN: with the filter OFF this matches the staged path
+                # (AF untouched); with it ON the record drops where the
+                # staged float() would raise -- strictly more tolerant,
+                # never silently keeps.
+                try:
+                    af_val = float(af[0]) if af else np.nan
+                except (TypeError, ValueError):
+                    af_val = np.nan
+                for c in rec.get("calls", ()):
+                    if any(g > 0 for g in c.get("genotype", ())):
+                        ords.append(ord_of[c["callset_id"]])
+                offs.append(len(ords))
+                if contig not in contig_of:
+                    contig_of[contig] = len(contig_table)
+                    contig_table.append(contig)
+                rec_contig.append(contig_of[contig])
+                vsid = rec.get("variant_set_id", "")
+                if vsid is None:
+                    # Explicit null never equals a queried id (a MISSING
+                    # key matches any); \x01 survives numpy U round-trips
+                    # where \x00 would not.
+                    vsid = "\x01"
+                if vsid not in vsid_of:
+                    vsid_of[vsid] = len(vsid_table)
+                    vsid_table.append(vsid)
+                rec_vsid.append(vsid_of[vsid])
+                starts.append(int(rec["start"]))
+                afs.append(af_val)
+        return (
+            contig_table,
+            np.array(rec_contig, np.int32),
+            np.array(starts, np.int64),
+            vsid_table,
+            np.array(rec_vsid, np.int32),
+            np.array(afs, np.float64),
+            np.array(offs, np.int64),
+            np.array(ords, np.int32),
+        )
+
+    @staticmethod
+    def _assemble(
+        digest,
+        callset_ids,
+        contig_table,
+        rec_contig,
+        starts,
+        vsid_table,
+        rec_vsid,
+        afs,
+        offsets,
+        ords,
+    ):
+        """File-ordered arrays -> per-contig sorted sidecar layout."""
+
+        def str_arr(values):
+            # Inferred itemsize: a fixed "U<n>" would silently truncate
+            # longer (e.g. URI-style) ids.
+            return np.array(
+                list(values), dtype=str if len(values) else "U1"
+            )
+
+        nv = len(starts)
+        # Stable sort by (contig name, start) -- contigs ranked by their
+        # sorted names; ties keep file order (lexsort is stable).
+        rank = np.zeros(max(len(contig_table), 1), dtype=np.int64)
+        order_c = sorted(
+            range(len(contig_table)), key=lambda i: contig_table[i]
+        )
+        rank[order_c] = np.arange(len(order_c))
+        rec_rank = (
+            rank[rec_contig] if nv else np.zeros(0, np.int64)
+        )
+        order = np.lexsort((starts, rec_rank))
+        starts_s = np.asarray(starts)[order]
+        afs_s = np.asarray(afs)[order]
+        # Variant-set codes re-numbered by first encounter in sorted
+        # order (the sorted-walk interning of the original builder).
+        vv = np.asarray(rec_vsid)[order]
+        if nv:
+            uniq, first = np.unique(vv, return_index=True)
+            old_codes = uniq[np.argsort(first, kind="stable")]
+            lookup = np.zeros(max(len(vsid_table), 1), dtype=np.int32)
+            lookup[old_codes] = np.arange(
+                len(old_codes), dtype=np.int32
+            )
+            vcode = lookup[vv]
+            vsid_new = [vsid_table[int(c)] for c in old_codes]
+        else:
+            vcode = np.zeros(0, dtype=np.int32)
+            vsid_new = []
+        # CSR gather in the new order.
+        lens = (offsets[1:] - offsets[:-1])[order]
+        new_offs = np.zeros(nv + 1, dtype=np.int64)
+        np.cumsum(lens, out=new_offs[1:])
+        if len(ords):
+            src_start = offsets[:-1][order]
+            idx = (
+                np.repeat(src_start, lens)
+                + np.arange(int(lens.sum()))
+                - np.repeat(new_offs[:-1], lens)
+            )
+            ords_s = ords[idx].astype(np.int32)
+        else:
+            ords_s = np.asarray(ords, dtype=np.int32)
+        # Contig segments over the sorted rows: position in the sorted
+        # name list IS the rank, by construction.
+        seg_contigs = sorted(contig_table)
+        rr_sorted = rec_rank[order]
+        seg_lo, seg_hi = [], []
+        for r, _cname in enumerate(seg_contigs):
+            seg_lo.append(int(np.searchsorted(rr_sorted, r, "left")))
+            seg_hi.append(int(np.searchsorted(rr_sorted, r, "right")))
+        return {
+            "digest": np.str_(digest),
+            "contigs": str_arr(seg_contigs),
+            "seg_lo": np.array(seg_lo, dtype=np.int64),
+            "seg_hi": np.array(seg_hi, dtype=np.int64),
+            "starts": starts_s.astype(np.int64),
+            "vcode": vcode,
+            "afs": afs_s.astype(np.float64),
+            "offsets": new_offs,
+            "ords": ords_s,
+            "vsids": str_arr(vsid_new),
+            "callset_ids": str_arr(callset_ids),
+        }
 
     def carrying(self, shard, indexes, variant_set_id, stats, min_af):
         """Per-variant carrying index lists for the shard — semantics of

@@ -14,7 +14,30 @@ import subprocess
 import threading
 from typing import Optional
 
-__all__ = ["load", "native_available"]
+__all__ = ["load", "native_available", "CohortCsr"]
+
+
+class CohortCsr(ctypes.Structure):
+    """Mirror of the C CohortCsr result struct (genomics_native.cpp)."""
+
+    _fields_ = [
+        ("n_variants", ctypes.c_int64),
+        ("n_calls", ctypes.c_int64),
+        ("n_contigs", ctypes.c_int64),
+        ("n_vsids", ctypes.c_int64),
+        ("error", ctypes.c_int64),
+        ("error_line", ctypes.c_int64),
+        ("starts", ctypes.POINTER(ctypes.c_int64)),
+        ("contig_code", ctypes.POINTER(ctypes.c_int32)),
+        ("vsid_code", ctypes.POINTER(ctypes.c_int32)),
+        ("afs", ctypes.POINTER(ctypes.c_double)),
+        ("offsets", ctypes.POINTER(ctypes.c_int64)),
+        ("ords", ctypes.POINTER(ctypes.c_int32)),
+        ("contig_blob", ctypes.POINTER(ctypes.c_char)),
+        ("contig_offs", ctypes.POINTER(ctypes.c_int64)),
+        ("vsid_blob", ctypes.POINTER(ctypes.c_char)),
+        ("vsid_offs", ctypes.POINTER(ctypes.c_int64)),
+    ]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "genomics_native.cpp")
@@ -97,6 +120,17 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_uint64,
             ctypes.c_void_p,
         ]
+        if hasattr(lib, "parse_cohort_jsonl"):
+            # A deployed tree may ship an older .so without the parser;
+            # the original entry points must keep working regardless.
+            lib.parse_cohort_jsonl.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+            ]
+            lib.parse_cohort_jsonl.restype = ctypes.POINTER(CohortCsr)
+            lib.cohort_csr_free.argtypes = [ctypes.POINTER(CohortCsr)]
         _lib = lib
         return _lib
 
