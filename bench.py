@@ -51,29 +51,15 @@ def _backend_guard():
     benchmark at all. A CPU number with a loud stderr warning beats a
     hang — the metric is rate-normalized either way.
     """
-    # The axon sitecustomize bakes the platform in before user code runs,
-    # so JAX_PLATFORMS alone is not a reliable signal — engage whenever
-    # the axon site could steer this process (and never on machines
-    # without it, which keep their native backends).
-    axon_possible = os.path.isdir("/root/.axon_site") or (
-        os.environ.get("JAX_PLATFORMS", "") == "axon"
-    )
-    if not axon_possible or os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return False
-    import socket
+    from spark_examples_tpu.utils.relay import cpu_failover_if_dead
 
-    try:
-        socket.create_connection(("127.0.0.1", 8093), timeout=5).close()
-        return False
-    except OSError:
+    if cpu_failover_if_dead():
         _log(
             "bench: WARNING — axon relay unreachable; falling back to CPU. "
             "These are NOT TPU numbers."
         )
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
         return True
+    return False
 
 
 def make_blocks(seed=0):

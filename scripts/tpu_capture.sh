@@ -1,0 +1,69 @@
+#!/bin/bash
+# One-shot TPU measurement capture for when the axon relay is alive.
+#
+# The relay has died mid-round twice (NOTES.md); any window of liveness
+# must yield every blocked measurement in one pass, ordered so the most
+# valuable record lands first and a mid-run relay death still leaves
+# earlier results on disk. Never run concurrently with another TPU
+# process (the chip is exclusive).
+#
+# Usage: bash scripts/tpu_capture.sh [outdir]   (default /tmp/tpu_capture)
+
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/tpu_capture}"
+mkdir -p "$OUT"
+
+if ! curl -s -m 5 http://127.0.0.1:8093/ >/dev/null 2>&1; then
+  echo "relay dead (8093 unreachable); aborting" >&2
+  exit 7
+fi
+echo "relay alive; capturing to $OUT" >&2
+
+# 1. The round's verdict-maker: bench.py on the chip (f32 + int8; the
+#    compilation cache makes the eigh compile a one-time cost).
+timeout 1800 python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
+echo "bench rc=$? ($(tail -c 300 "$OUT/bench.json" 2>/dev/null))" >&2
+
+# 2. Gramian mode table: f32/int8 einsum vs both Pallas kernels — the
+#    default-picking data (NOTES agenda #1, VERDICT #5).
+timeout 1800 python scripts/tpu_microbench.py \
+  >"$OUT/microbench.txt" 2>"$OUT/microbench.log"
+echo "microbench rc=$?" >&2
+
+# 3. chr20-scale pipeline probe on the chip (stage split; VERDICT #7).
+#    Warm sidecar cohort if present, else in-memory fixture.
+if [ -d /tmp/cohort32k ]; then
+  SRC_ARGS="--input-path /tmp/cohort32k"
+else
+  SRC_ARGS="--fixture-samples 2504 --fixture-variants 32768 --fixture-sparse-calls"
+fi
+timeout 1800 python -m spark_examples_tpu.cli.main pca \
+  $SRC_ARGS --references 20:1:63025520 \
+  --output-path "$OUT/chr20" >"$OUT/chr20_probe.txt" 2>&1
+echo "chr20 probe rc=$?" >&2
+
+# 4. Pallas numerical check on hardware (bit-exactness vs einsum).
+timeout 900 python - >"$OUT/pallas_exact.txt" 2>&1 <<'EOF'
+import numpy as np, jax, jax.numpy as jnp
+from spark_examples_tpu.ops.gramian import gramian
+from spark_examples_tpu.ops.pallas_gramian import (
+    BLOCK_N,
+    gramian_accumulate_pallas,
+    gramian_accumulate_pallas_sym,
+)
+from spark_examples_tpu.arrays.blocks import round_up_multiple
+n = round_up_multiple(1024, BLOCK_N)
+x = (np.random.default_rng(0).random((n, 2048)) < 0.1).astype(np.int8)
+want = np.asarray(gramian(x))
+xd = jax.device_put(x)
+for name, fn in (
+    ("dense", gramian_accumulate_pallas),
+    ("sym", gramian_accumulate_pallas_sym),
+):
+    got = np.asarray(fn(jnp.zeros((n, n), jnp.float32), xd))
+    print(name, "bit-exact:", np.array_equal(got, want))
+EOF
+echo "pallas exact rc=$?" >&2
+
+echo "capture complete: $(ls "$OUT")" >&2

@@ -209,15 +209,19 @@ def _carrying_records(records, indexes, variant_set_id, stats, min_af):
     - hasVariation = any genotype allele > 0 (VariantsPca.scala:56-60);
     - unknown callset ids raise KeyError, as the reference's
       ``mapping(call.callsetId)`` throws;
-    - empty index lists are dropped (getCallsRdd, VariantsPca.scala:157-160).
+    - empty index lists are dropped (getCallsRdd, VariantsPca.scala:157-160);
+    - the ONE variant-set rule (applied identically by every ingest path,
+      dict or object or sidecar): a falsy stored id — missing key, null,
+      "" — matches any query; a non-empty stored id must equal a
+      non-empty query. (Serialization turns a missing key into an
+      explicit "", so "" must stay a wildcard or HTTP round-trips would
+      change filtering.)
     """
     from spark_examples_tpu.genomics.types import normalize_contig
 
     for rec in records:
-        if (
-            variant_set_id
-            and rec.get("variant_set_id", variant_set_id) != variant_set_id
-        ):
+        stored = rec.get("variant_set_id")
+        if variant_set_id and stored and stored != variant_set_id:
             continue
         if normalize_contig(rec["reference_name"]) is None:
             continue
@@ -368,9 +372,10 @@ class FixtureSource:
             if isinstance(item, Variant):
                 v = item
             else:
-                if variant_set_id and item.get(
-                    "variant_set_id", variant_set_id
-                ) != variant_set_id:
+                stored = item.get("variant_set_id")
+                # The one variant-set rule (see _carrying_records): falsy
+                # stored id is a wildcard, non-empty must equal.
+                if variant_set_id and stored and stored != variant_set_id:
                     continue
                 v = variant_from_record(item)
                 if v is None:  # dropped contig
@@ -628,6 +633,7 @@ class _CsrCohort:
                 arr(c.afs, nv, np.float64),
                 arr(c.offsets, nv + 1, np.int64),
                 arr(c.ords, nc, np.int32),
+                [],
             )
         finally:
             lib.cohort_csr_free(res)
@@ -639,6 +645,14 @@ class _CsrCohort:
         from spark_examples_tpu.genomics.types import normalize_contig
 
         ord_of = {cid: i for i, cid in enumerate(callset_ids)}
+        # Callset ids absent from callsets.json get ordinals past the
+        # known table: the STAGED path only raises KeyError when a
+        # QUERIED record references an unknown id, so the build must not
+        # crash on out-of-scope records — carrying() resolves lazily and
+        # raises with the true id only when such a record is actually
+        # served.
+        extra_ids: List[str] = []
+        extra_of: dict = {}
         contig_table: List[str] = []
         contig_of: dict = {}
         vsid_table: List[str] = []
@@ -663,18 +677,23 @@ class _CsrCohort:
                     af_val = np.nan
                 for c in rec.get("calls", ()):
                     if any(g > 0 for g in c.get("genotype", ())):
-                        ords.append(ord_of[c["callset_id"]])
+                        cid = c["callset_id"]
+                        code = ord_of.get(cid)
+                        if code is None:
+                            code = extra_of.get(cid)
+                            if code is None:
+                                code = len(callset_ids) + len(extra_of)
+                                extra_of[cid] = code
+                                extra_ids.append(cid)
+                        ords.append(code)
                 offs.append(len(ords))
                 if contig not in contig_of:
                     contig_of[contig] = len(contig_table)
                     contig_table.append(contig)
                 rec_contig.append(contig_of[contig])
-                vsid = rec.get("variant_set_id", "")
-                if vsid is None:
-                    # Explicit null never equals a queried id (a MISSING
-                    # key matches any); \x01 survives numpy U round-trips
-                    # where \x00 would not.
-                    vsid = "\x01"
+                # Falsy stored ids (missing/null/"") are wildcards under
+                # the one variant-set rule — store them uniformly as "".
+                vsid = rec.get("variant_set_id") or ""
                 if vsid not in vsid_of:
                     vsid_of[vsid] = len(vsid_table)
                     vsid_table.append(vsid)
@@ -690,6 +709,7 @@ class _CsrCohort:
             np.array(afs, np.float64),
             np.array(offs, np.int64),
             np.array(ords, np.int32),
+            extra_ids,
         )
 
     @staticmethod
@@ -704,8 +724,13 @@ class _CsrCohort:
         afs,
         offsets,
         ords,
+        extra_ids=(),
     ):
-        """File-ordered arrays -> per-contig sorted sidecar layout."""
+        """File-ordered arrays -> per-contig sorted sidecar layout.
+
+        ``extra_ids`` are callset ids seen in records but absent from
+        callsets.json; their ordinals continue past the known table so
+        queries can report the true id when raising."""
 
         def str_arr(values):
             # Inferred itemsize: a fixed "U<n>" would silently truncate
@@ -776,7 +801,7 @@ class _CsrCohort:
             "offsets": new_offs,
             "ords": ords_s,
             "vsids": str_arr(vsid_new),
-            "callset_ids": str_arr(callset_ids),
+            "callset_ids": str_arr(list(callset_ids) + list(extra_ids)),
         }
 
     def carrying(self, shard, indexes, variant_set_id, stats, min_af):
@@ -892,11 +917,10 @@ class JsonlSource:
     ) -> Iterator[Variant]:
         self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
         for rec in self._variants_index().slice(shard):
-            if (
-                variant_set_id
-                and rec.get("variant_set_id", variant_set_id)
-                != variant_set_id
-            ):
+            stored = rec.get("variant_set_id")
+            # The one variant-set rule (see _carrying_records): falsy
+            # stored id is a wildcard, non-empty must equal.
+            if variant_set_id and stored and stored != variant_set_id:
                 continue
             v = variant_from_record(rec)
             if v is None:

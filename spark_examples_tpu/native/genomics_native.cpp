@@ -432,12 +432,9 @@ bool parse_line(const char* line, const char* line_end, CohortImpl* out,
       if (lp.peek('"')) {
         if (!lp.string_exact(&vsid)) return false;
       } else {
-        // Explicit null: in the record-dict path a null value never
-        // equals a queried id (unlike a MISSING key, which matches any).
-        // \x01 is a value no real id contains and — unlike \x00 — one
-        // that numpy U-arrays round-trip.
+        // Explicit null: a falsy stored id is a wildcard under the one
+        // variant-set rule, same as missing — keep vsid "".
         lp.skip_value();
-        vsid.assign(1, '\x01');
       }
     } else if (key == "info") {
       if (seen_info) {
@@ -557,7 +554,10 @@ bool parse_line(const char* line, const char* line_end, CohortImpl* out,
             }
             auto it = ord_of.find(cid);
             if (it == ord_of.end()) {
-              lp.err = true;  // unknown callset: fall back (KeyError)
+              // Unknown callset: fall back to the Python parser, which
+              // interns it into the extra-id table for lazy per-query
+              // KeyError semantics.
+              lp.err = true;
               return false;
             }
             row_ords.push_back(it->second);

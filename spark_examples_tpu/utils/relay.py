@@ -1,0 +1,54 @@
+"""Axon TPU relay detection — shared by every entry point that must not
+hang on a dead tunnel.
+
+The axon sitecustomize registers the TPU plugin before user code runs and
+bakes the platform in, so ``JAX_PLATFORMS`` alone is NOT a reliable
+signal; presence of the site dir (or an explicit axon platform setting)
+is. When the relay is dead, backend init blocks forever dialing it —
+``import jax`` itself is safe, which is why a ``jax.config`` override
+after import works (see NOTES.md hardware incidents).
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["axon_possible", "relay_alive", "cpu_failover_if_dead"]
+
+RELAY_ADDR = ("127.0.0.1", 8093)
+AXON_SITE = "/root/.axon_site"
+
+
+def axon_possible() -> bool:
+    """Could the axon plugin steer this process?"""
+    return os.path.isdir(AXON_SITE) or (
+        os.environ.get("JAX_PLATFORMS", "") == "axon"
+    )
+
+
+def relay_alive(timeout: float = 5.0) -> bool:
+    import socket
+
+    try:
+        socket.create_connection(RELAY_ADDR, timeout=timeout).close()
+        return True
+    except OSError:
+        return False
+
+
+def cpu_failover_if_dead() -> bool:
+    """Force the CPU backend when the relay is dead; True if engaged.
+
+    No-op on machines without the axon site (they keep their native
+    backends) and when the platform is already explicitly cpu.
+    """
+    if not axon_possible():
+        return False
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return False
+    if relay_alive():
+        return False
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return True

@@ -250,3 +250,110 @@ class TestCsrSidecar:
             fresh, DEFAULT_VARIANT_SET_ID, shards, index.indexes, None
         )
         assert len(after) == len(before) + 1
+
+
+class TestVariantSetRule:
+    """The ONE variant-set rule: falsy stored id = wildcard, non-empty
+    must equal — identical across staged, fused, sidecar, and HTTP."""
+
+    def _vsidless(self):
+        src = _cohort()
+        for rec in src._variants:
+            rec.pop("variant_set_id", None)
+        return src
+
+    def test_http_round_trip_keeps_vsidless_records(self):
+        # Serialization turns a missing key into an explicit "" — the
+        # fused client path must keep those exactly like the staged one.
+        from spark_examples_tpu.genomics.service import (
+            GenomicsServiceServer,
+            HttpVariantSource,
+        )
+
+        server = GenomicsServiceServer(self._vsidless()).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            shards = shards_for_references(REFS, 20_000)
+            ref = CallsetIndex.from_source(
+                _cohort(), [DEFAULT_VARIANT_SET_ID]
+            )
+            staged = _slow(
+                HttpVariantSource(url),
+                DEFAULT_VARIANT_SET_ID,
+                shards,
+                ref.indexes,
+                None,
+            )
+            fused = _fast(
+                HttpVariantSource(url),
+                DEFAULT_VARIANT_SET_ID,
+                shards,
+                ref.indexes,
+                None,
+            )
+            assert staged and fused == staged
+        finally:
+            server.stop()
+
+    def test_jsonl_explicit_empty_vsid_is_wildcard(self, tmp_path):
+        import json as _json
+        import os
+
+        root = str(tmp_path / "c")
+        self._vsidless().dump(root)
+        # dump writes records without the key; rewrite with explicit "".
+        path = os.path.join(root, "variants.jsonl")
+        recs = [
+            {**_json.loads(line), "variant_set_id": ""}
+            for line in open(path)
+        ]
+        with open(path, "w") as f:
+            for rec in recs:
+                f.write(_json.dumps(rec) + "\n")
+        shards = shards_for_references(REFS, 20_000)
+        ref = CallsetIndex.from_source(_cohort(), [DEFAULT_VARIANT_SET_ID])
+        staged = _slow(
+            JsonlSource(root), DEFAULT_VARIANT_SET_ID, shards, ref.indexes, None
+        )
+        fused = _fast(
+            JsonlSource(root), DEFAULT_VARIANT_SET_ID, shards, ref.indexes, None
+        )
+        assert staged and fused == staged
+
+
+class TestUnknownCallsetLazy:
+    def test_out_of_scope_unknown_callset_does_not_crash_build(
+        self, tmp_path
+    ):
+        """An unknown callset in a record OUTSIDE the query must not
+        break fused ingest (the staged path never touches it); querying
+        the bad record itself still raises with the true id."""
+        import json as _json
+        import os
+
+        root = str(tmp_path / "c")
+        _cohort().dump(root)
+        bad = {
+            "reference_name": "18",
+            "start": 500,
+            "end": 501,
+            "reference_bases": "A",
+            "alternate_bases": ["G"],
+            "variant_set_id": DEFAULT_VARIANT_SET_ID,
+            "calls": [{"callset_id": "ghost-callset", "genotype": [0, 1]}],
+        }
+        with open(os.path.join(root, "variants.jsonl"), "a") as f:
+            f.write(_json.dumps(bad) + "\n")
+        js = JsonlSource(root)
+        index = CallsetIndex.from_source(js, [DEFAULT_VARIANT_SET_ID])
+        shards = shards_for_references(REFS, 20_000)
+        # chr17 query: works, ghost record never touched.
+        assert _fast(js, DEFAULT_VARIANT_SET_ID, shards, index.indexes, None)
+        # chr18 query hits the ghost record: KeyError with the true id.
+        bad_shard = shards_for_references("18:0:1000", 1_000)[0]
+        with pytest.raises(KeyError, match="ghost-callset"):
+            list(
+                js.stream_carrying(
+                    DEFAULT_VARIANT_SET_ID, bad_shard, index.indexes
+                )
+            )
