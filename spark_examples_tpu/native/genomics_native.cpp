@@ -142,6 +142,36 @@ void murmur3_x64_128_batch(const uint8_t* data, const int64_t* offsets,
 
 namespace {
 
+// Strict JSON number grammar (plus json.loads' Infinity/-Infinity/NaN
+// extensions) — strtod alone accepts hex floats ("0x10") that
+// json.loads rejects, which would let the native parser silently accept
+// files the Python fallback raises on.
+bool json_number_valid(const std::string& t) {
+  if (t == "Infinity" || t == "-Infinity" || t == "NaN") return true;
+  size_t i = 0;
+  if (i < t.size() && t[i] == '-') ++i;
+  if (i >= t.size()) return false;
+  if (t[i] == '0') {
+    ++i;
+  } else if (t[i] >= '1' && t[i] <= '9') {
+    while (i < t.size() && t[i] >= '0' && t[i] <= '9') ++i;
+  } else {
+    return false;
+  }
+  if (i < t.size() && t[i] == '.') {
+    ++i;
+    if (i >= t.size() || t[i] < '0' || t[i] > '9') return false;
+    while (i < t.size() && t[i] >= '0' && t[i] <= '9') ++i;
+  }
+  if (i < t.size() && (t[i] == 'e' || t[i] == 'E')) {
+    ++i;
+    if (i < t.size() && (t[i] == '+' || t[i] == '-')) ++i;
+    if (i >= t.size() || t[i] < '0' || t[i] > '9') return false;
+    while (i < t.size() && t[i] >= '0' && t[i] <= '9') ++i;
+  }
+  return i == t.size();
+}
+
 struct LineParser {
   const char* p;
   const char* end;
@@ -252,9 +282,7 @@ struct LineParser {
       }
       std::string tok(s, p - s);
       if (tok == "true" || tok == "false" || tok == "null") return;
-      char* e = nullptr;
-      std::strtod(tok.c_str(), &e);
-      if (tok.empty() || e != tok.c_str() + tok.size()) err = true;
+      if (!json_number_valid(tok)) err = true;
     }
   }
 
@@ -287,13 +315,11 @@ struct LineParser {
     if (err) return NAN;
     std::string tmp(s, p - s);
     if (tmp == "null") return NAN;
-    char* e = nullptr;
-    double v = std::strtod(tmp.c_str(), &e);
-    if (tmp.empty() || e != tmp.c_str() + tmp.size()) {
+    if (!json_number_valid(tmp)) {
       err = true;  // not a JSON number: json.loads would reject the line
       return NAN;
     }
-    return v;
+    return std::strtod(tmp.c_str(), nullptr);
   }
 };
 

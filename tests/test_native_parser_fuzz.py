@@ -1,0 +1,200 @@
+"""Differential fuzz: native JSONL parser vs the Python reference parse.
+
+Contract under test (sources._CsrCohort): for ANY input file, the native
+parser either produces arrays identical to the Python parser or refuses
+(returns None / error) so the Python parser decides — including inputs
+where Python itself raises. It must never silently diverge.
+"""
+
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.genomics.sources import JsonlSource, _CsrCohort
+from spark_examples_tpu.native import load
+
+CALLSET_IDS = [f"cs-{i}" for i in range(6)]
+
+pytestmark = pytest.mark.skipif(
+    load() is None or not hasattr(load(), "parse_cohort_jsonl"),
+    reason="native core unavailable",
+)
+
+CONTIG_POOL = [
+    "17",
+    "chr17",
+    "chrX",
+    "chrX_alt",
+    "",
+    "chr",
+    "x17",
+    "17x",
+    "HLA-A",
+    "chr0017",
+    "ünicode",
+    'quote"inside',
+    "back\\slash",
+]
+AF_POOL = [
+    ["0.25"],
+    ["0.000001"],
+    ["."],
+    [""],
+    [0.5],
+    [1],
+    ["1e-3"],
+    ["nan"],
+    [None],
+    [],
+    ["0.1", "0.9"],
+]
+VSID_POOL = ["vs1", "vs2", "", None, "ünicode-vs", 'v"s']
+GT_POOL = [[0, 0], [0, 1], [1, 1], [-1, -1], [2, 0], [], [0], [1, -1, 0]]
+
+
+def _random_record(rng):
+    rec = {}
+    if rng.random() < 0.95:
+        rec["reference_name"] = rng.choice(CONTIG_POOL)
+    if rng.random() < 0.95:
+        rec["start"] = rng.randrange(0, 10_000_000)
+    if rng.random() < 0.5:
+        rec["end"] = rng.randrange(0, 10_000_000)
+    if rng.random() < 0.6:
+        rec["variant_set_id"] = rng.choice(VSID_POOL)
+    if rng.random() < 0.5:
+        rec["reference_bases"] = rng.choice(["A", "N", "ACGT", ""])
+    if rng.random() < 0.4:
+        rec["alternate_bases"] = rng.choice([["G"], ["G", "T"], [], None])
+    if rng.random() < 0.6:
+        info = {}
+        if rng.random() < 0.8:
+            info["AF"] = rng.choice(AF_POOL)
+        if rng.random() < 0.3:
+            info["OTHER"] = ["x", 1, None]
+        rec["info"] = info
+    if rng.random() < 0.85:
+        calls = []
+        for _ in range(rng.randrange(0, 5)):
+            call = {}
+            if rng.random() < 0.95:
+                call["callset_id"] = rng.choice(
+                    CALLSET_IDS + ["ghost", "üid"]
+                )
+            if rng.random() < 0.95:
+                call["genotype"] = rng.choice(GT_POOL)
+            if rng.random() < 0.2:
+                call["phaseset"] = rng.choice(["ps1", ""])
+            if rng.random() < 0.1:
+                call["info"] = {"DP": [rng.randrange(0, 99)]}
+            calls.append(call)
+        rec["calls"] = calls
+    return rec
+
+
+def _adversarial_lines(rng):
+    """Raw lines json.dumps cannot produce: duplicate keys, weird tokens,
+    broken JSON. The native parser must refuse or match Python."""
+    return [
+        # duplicate extracted keys (json.loads: last-wins)
+        '{"reference_name": "17", "start": 1, "calls": '
+        '[{"callset_id": "cs-0", "genotype": [1]}], "calls": '
+        '[{"callset_id": "cs-1", "genotype": [1]}]}',
+        '{"reference_name": "17", "reference_name": "18", "start": 2, '
+        '"calls": []}',
+        '{"reference_name": "17", "start": 3, "start": 4, "calls": []}',
+        '{"reference_name": "17", "start": 5, "info": {"AF": ["0.1"]}, '
+        '"info": {}}',
+        # invalid bare tokens / broken JSON (json.loads raises)
+        '{"reference_name": "17", "start": 6, "junk": blah}',
+        '{"reference_name": "17", "start": 7',
+        '{"reference_name": "17", "start": 8, "info": {"AF": [0x10]}}',
+        "not json at all",
+        # escapes in extracted strings (valid JSON; native must refuse)
+        '{"reference_name": "chr\\u005f17", "start": 9, "calls": []}',
+        '{"reference_name": "17", "start": 10, "variant_set_id": '
+        '"a\\"b", "calls": []}',
+        # whitespace/format variants (valid)
+        '  {  "reference_name" : "17" , "start" : 11 , "calls" : [ ] }  ',
+        '{"reference_name": "17", "start": 12, "extra": {"deep": '
+        '[{"n": [1, 2, {"x": null}]}, true, false]}, "calls": []}',
+    ]
+
+
+def _compare(tmp_path, lines, tag):
+    root = tmp_path / tag
+    os.makedirs(root)
+    (root / "callsets.json").write_text(
+        json.dumps(
+            [
+                {"id": cid, "name": cid, "variant_set_id": "vs1"}
+                for cid in CALLSET_IDS
+            ]
+        )
+    )
+    (root / "variants.jsonl").write_text(
+        "\n".join(lines) + ("\n" if lines else "")
+    )
+    js = JsonlSource(str(root))
+    native = _CsrCohort._parse_native(str(root), CALLSET_IDS)
+    try:
+        python = _CsrCohort._parse_python(js._open, CALLSET_IDS)
+        python_raised = None
+    except Exception as e:  # noqa: BLE001 — part of the contract
+        python_raised = e
+        python = None
+    if python_raised is not None:
+        # Python refuses the file: native must have refused too.
+        assert native is None, (
+            f"native accepted a file Python rejects ({python_raised!r})"
+        )
+        return
+    if native is None:
+        return  # conservative refusal is always allowed
+    for name, a, b in zip(
+        (
+            "contig_table",
+            "rec_contig",
+            "starts",
+            "vsid_table",
+            "rec_vsid",
+            "afs",
+            "offsets",
+            "ords",
+            "extra_ids",
+        ),
+        native,
+        python,
+    ):
+        if isinstance(a, list):
+            assert a == b, (tag, name, a, b)
+        else:
+            np.testing.assert_array_equal(a, b, err_msg=f"{tag}:{name}")
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_cohorts_native_matches_or_refuses(tmp_path, seed):
+    rng = random.Random(seed)
+    n = rng.randrange(1, 60)
+    ensure_ascii = rng.random() < 0.5
+    lines = [
+        json.dumps(_random_record(rng), ensure_ascii=ensure_ascii)
+        for _ in range(n)
+    ]
+    _compare(tmp_path, lines, f"seed{seed}")
+
+
+def test_adversarial_lines_one_per_file(tmp_path):
+    rng = random.Random(99)
+    for i, line in enumerate(_adversarial_lines(rng)):
+        _compare(tmp_path, [line], f"adv{i}")
+
+
+def test_adversarial_lines_mixed_with_valid(tmp_path):
+    rng = random.Random(7)
+    valid = [json.dumps(_random_record(rng)) for _ in range(5)]
+    for i, line in enumerate(_adversarial_lines(rng)):
+        _compare(tmp_path, valid + [line], f"mix{i}")
