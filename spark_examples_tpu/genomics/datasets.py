@@ -14,7 +14,6 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence
 
 import itertools
 
-from spark_examples_tpu.genomics.hashing import variant_identities
 from spark_examples_tpu.genomics.types import Variant, has_variation
 
 __all__ = [
@@ -23,6 +22,9 @@ __all__ = [
     "join_datasets",
     "merge_datasets",
     "calls_stream",
+    "join_keyed",
+    "merge_keyed",
+    "calls_stream_keyed",
 ]
 
 
@@ -59,29 +61,15 @@ def carrying_sample_indices(
     return out
 
 
-def _keyed(stream, chunk: int = 65536):
-    """Yield (identity, variant) lazily, hashing in bounded chunks.
-
-    Keeps the one-native-call-per-chunk batching win without materializing
-    the stream (multi-million-variant cohorts must not be held in memory
-    to be joined).
-    """
-    it = iter(stream)
-    while True:
-        block = list(itertools.islice(it, chunk))
-        if not block:
-            return
-        yield from zip(variant_identities(block), block)
-
-
 def _flatten_runs(runs):
     for _, group in runs:
         yield from group
 
 
 def _aligned_chunks(
-    streams: Sequence[Iterable[Variant]],
-) -> Iterator[List[Iterable[Variant]]]:
+    streams: Sequence[Iterable],
+    contig_of=lambda v: v.contig,
+) -> Iterator[List[Iterable]]:
     """Align the streams into per-contig chunks for bounded-memory joins.
 
     The variant identity hash embeds the contig, so records on different
@@ -107,7 +95,7 @@ def _aligned_chunks(
     below do exactly that.
     """
     runs = [
-        itertools.groupby(s, key=lambda v: v.contig) for s in streams
+        itertools.groupby(s, key=contig_of) for s in streams
     ]
     seen = set()
     while True:
@@ -139,6 +127,22 @@ def _aligned_chunks(
             return
 
 
+def _variant_triples(stream, indexes):
+    """Built Variants → the keyed-triple shape the join engine consumes
+    (identity payload fields per VariantsPca.scala:62-78)."""
+    from spark_examples_tpu.genomics.hashing import _identity_payload
+
+    for v in stream:
+        yield (
+            v.contig,
+            _identity_payload(
+                v.contig, v.start, v.end,
+                v.reference_bases, v.alternate_bases,
+            ),
+            carrying_sample_indices(v, indexes),
+        )
+
+
 def join_datasets(
     a: Iterable[Variant],
     b: Iterable[Variant],
@@ -158,21 +162,13 @@ def join_datasets(
     it, join state is bounded per contig via :func:`_aligned_chunks`
     instead of growing with the whole cohort.
     """
-    chunk_pairs = (
-        _aligned_chunks([a, b]) if contig_runs_unique else iter([[a, b]])
+    # Adapter over the keyed engine: staged and fused joins share ONE
+    # state machine, so they cannot diverge by construction.
+    return join_keyed(
+        _variant_triples(a, indexes),
+        _variant_triples(b, indexes),
+        contig_runs_unique,
     )
-    for chunk_a, chunk_b in chunk_pairs:
-        left: Dict[str, List[List[int]]] = {}
-        for key, v in _keyed(chunk_a):
-            left.setdefault(key, []).append(
-                carrying_sample_indices(v, indexes)
-            )
-        for key, v in _keyed(chunk_b):
-            rows = left.get(key)
-            if rows is not None:
-                right = carrying_sample_indices(v, indexes)
-                for left_calls in rows:
-                    yield left_calls + right
 
 
 def merge_datasets(
@@ -188,22 +184,10 @@ def merge_datasets(
     via :func:`_aligned_chunks` under the ``contig_runs_unique`` promise
     (see :func:`join_datasets`).
     """
-    want = len(streams)
-    chunk_sets = (
-        _aligned_chunks(streams) if contig_runs_unique else iter([streams])
+    return merge_keyed(
+        [_variant_triples(st, indexes) for st in streams],
+        contig_runs_unique,
     )
-    for chunks in chunk_sets:
-        groups: Dict[str, List[int]] = {}
-        counts: Dict[str, int] = {}
-        for chunk in chunks:
-            for key, v in _keyed(chunk):
-                counts[key] = counts.get(key, 0) + 1
-                groups.setdefault(key, []).extend(
-                    carrying_sample_indices(v, indexes)
-                )
-        for key, calls in groups.items():
-            if counts[key] == want:
-                yield calls
 
 
 def calls_stream(
@@ -221,6 +205,94 @@ def calls_stream(
         )
     else:
         gen = merge_datasets(streams, indexes, contig_runs_unique)
+    for calls in gen:
+        if calls:
+            yield calls
+
+
+# -- fused (keyed-triple) multi-dataset path ---------------------------------
+#
+# The fast-path twin of join/merge_datasets: sources emit
+# (contig, identity payload, carrying indices) triples
+# (sources._carrying_keyed_records) so no Call/Variant objects are built;
+# payloads hash in batches through the native murmur3 core.
+
+
+def _hashed(triples, chunk: int = 65536):
+    """(contig, payload, calls) → (identity key, calls), hashing payloads
+    in bounded batches (one native call per chunk)."""
+    from spark_examples_tpu.genomics.hashing import hash_payloads
+
+    it = iter(triples)
+    while True:
+        block = list(itertools.islice(it, chunk))
+        if not block:
+            return
+        keys = hash_payloads(t[1] for t in block)
+        for key, t in zip(keys, block):
+            yield key, t[2]
+
+
+def _triple_contig(t):
+    return t[0]
+
+
+def join_keyed(a, b, contig_runs_unique: bool = False):
+    """Keyed-triple twin of :func:`join_datasets` — identical semantics
+    (pair-per-record inner join, per-contig bounded state under the
+    unique-runs contract), inputs already carrying-extracted."""
+    chunk_pairs = (
+        _aligned_chunks([a, b], contig_of=_triple_contig)
+        if contig_runs_unique
+        else iter([[a, b]])
+    )
+    for chunk_a, chunk_b in chunk_pairs:
+        left: Dict[str, List[List[int]]] = {}
+        for key, calls in _hashed(chunk_a):
+            left.setdefault(key, []).append(calls)
+        for key, calls in _hashed(chunk_b):
+            rows = left.get(key)
+            if rows is not None:
+                for left_calls in rows:
+                    yield left_calls + calls
+
+
+def merge_keyed(streams, contig_runs_unique: bool = False):
+    """Keyed-triple twin of :func:`merge_datasets` (present-in-all by
+    record count, VariantsPca.scala:136-148)."""
+    want = len(streams)
+    chunk_sets = (
+        _aligned_chunks(streams, contig_of=_triple_contig)
+        if contig_runs_unique
+        else iter([streams])
+    )
+    for chunks in chunk_sets:
+        groups: Dict[str, List[int]] = {}
+        counts: Dict[str, int] = {}
+        for chunk in chunks:
+            for key, calls in _hashed(chunk):
+                counts[key] = counts.get(key, 0) + 1
+                groups.setdefault(key, []).extend(calls)
+        for key, calls in groups.items():
+            if counts[key] == want:
+                yield calls
+
+
+def calls_stream_keyed(streams, contig_runs_unique: bool = False):
+    """Multi-dataset dispatch over keyed triples, dropping variants with
+    no carrying samples after concatenation (getCallsRdd semantics)."""
+    if len(streams) < 2:
+        # A single stream has no join semantics; the N-way merge would
+        # silently DROP duplicate identities (count != want). Use
+        # calls_stream / the carrying fast path for one dataset.
+        raise ValueError(
+            "calls_stream_keyed needs >= 2 datasets; got "
+            f"{len(streams)}"
+        )
+    if len(streams) == 2:
+        gen = join_keyed(streams[0], streams[1], contig_runs_unique)
+    else:
+        gen = merge_keyed(streams, contig_runs_unique)
     for calls in gen:
         if calls:
             yield calls

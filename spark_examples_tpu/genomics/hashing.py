@@ -138,33 +138,17 @@ def _identity_payload(
     )
 
 
-def variant_identities(variants) -> list:
-    """Batch identity hashing — the join/merge hot path.
-
-    One native call over a concatenated payload buffer instead of one
-    ctypes round-trip per variant; falls back to per-variant hashing when
-    the native core is unavailable.
-    """
-    variants = list(variants)
+def hash_payloads(payloads) -> list:
+    """Batch murmur3 over identity payload byte strings — the join/merge
+    hot path. One native call over a concatenated buffer instead of one
+    ctypes round-trip per payload; per-payload Python hashing when the
+    native core is unavailable."""
+    payloads = list(payloads)
     lib = _native()
-    if lib is None or not variants:
-        return [
-            murmur3_x64_128(
-                _identity_payload(
-                    v.contig, v.start, v.end,
-                    v.reference_bases, v.alternate_bases,
-                )
-            ).hex()
-            for v in variants
-        ]
+    if lib is None or not payloads:
+        return [murmur3_x64_128(p).hex() for p in payloads]
     import itertools
 
-    payloads = [
-        _identity_payload(
-            v.contig, v.start, v.end, v.reference_bases, v.alternate_bases
-        )
-        for v in variants
-    ]
     offsets = (ctypes.c_int64 * (len(payloads) + 1))(
         *itertools.accumulate(map(len, payloads), initial=0)
     )
@@ -173,6 +157,16 @@ def variant_identities(variants) -> list:
     lib.murmur3_x64_128_batch(blob, offsets, len(payloads), 0, out)
     raw = out.raw
     return [raw[i * 16 : (i + 1) * 16].hex() for i in range(len(payloads))]
+
+
+def variant_identities(variants) -> list:
+    """Batch identity hashing of built Variant objects."""
+    return hash_payloads(
+        _identity_payload(
+            v.contig, v.start, v.end, v.reference_bases, v.alternate_bases
+        )
+        for v in variants
+    )
 
 
 def variant_identity(

@@ -256,9 +256,10 @@ class HttpVariantSource:
             for r in rows
         ]
 
-    def stream_variants(
-        self, variant_set_id: str, shard: Shard
-    ) -> Iterator[Variant]:
+    def _wire_variant_records(self, variant_set_id: str, shard: Shard):
+        """One shard request → parsed wire records (shared by the staged
+        and both fused streaming paths: stats, params, and framing live
+        here once)."""
         self.stats.add(partitions=1, reference_bases=shard.range)
         resp = self._request(
             "/variants",
@@ -269,8 +270,16 @@ class HttpVariantSource:
                 "end": shard.end,
             },
         )
-        for line in self._stream_lines(resp, "/variants"):
-            v = variant_from_record(json.loads(line))
+        return (
+            json.loads(line)
+            for line in self._stream_lines(resp, "/variants")
+        )
+
+    def stream_variants(
+        self, variant_set_id: str, shard: Shard
+    ) -> Iterator[Variant]:
+        for rec in self._wire_variant_records(variant_set_id, shard):
+            v = variant_from_record(rec)
             if v is None:
                 continue
             self.stats.add(variants_read=1)
@@ -317,18 +326,29 @@ class HttpVariantSource:
         slicing, contig normalization, and the variant-set filter."""
         from spark_examples_tpu.genomics.sources import _carrying_records
 
-        self.stats.add(partitions=1, reference_bases=shard.range)
-        resp = self._request(
-            "/variants",
-            {
-                "variant_set_id": variant_set_id,
-                "contig": shard.contig,
-                "start": shard.start,
-                "end": shard.end,
-            },
-        )
         yield from _carrying_records(
-            (json.loads(line) for line in self._stream_lines(resp, "/variants")),
+            self._wire_variant_records(variant_set_id, shard),
+            indexes,
+            variant_set_id,
+            self.stats,
+            min_allele_frequency,
+        )
+
+    def stream_carrying_keyed(
+        self,
+        variant_set_id: str,
+        shard: Shard,
+        indexes: dict,
+        min_allele_frequency=None,
+    ):
+        """Fused multi-dataset fast path over the wire records (see
+        sources._carrying_keyed_records)."""
+        from spark_examples_tpu.genomics.sources import (
+            _carrying_keyed_records,
+        )
+
+        yield from _carrying_keyed_records(
+            self._wire_variant_records(variant_set_id, shard),
             indexes,
             variant_set_id,
             self.stats,

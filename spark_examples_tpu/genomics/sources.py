@@ -192,6 +192,40 @@ def _strip_chr(name: str) -> str:
     return name[3:] if name.startswith("chr") else name
 
 
+def _extracted_records(records, indexes, variant_set_id, stats, min_af):
+    """The ONE record-extraction loop every fused path shares.
+
+    Yields (record, normalized contig, carrying indices) applying the
+    full shared semantics — variant-set wildcard rule, contig drop,
+    variants_read accounting, AF NaN-drop, hasVariation, KeyError on
+    unknown callsets. Wrappers shape the output; the semantics live here
+    exactly once.
+    """
+    from spark_examples_tpu.genomics.types import normalize_contig
+
+    for rec in records:
+        stored = rec.get("variant_set_id")
+        if variant_set_id and stored and stored != variant_set_id:
+            continue
+        contig = normalize_contig(rec["reference_name"])
+        if contig is None:
+            continue
+        stats.add(variants_read=1)
+        if min_af is not None:
+            af = (rec.get("info") or {}).get("AF")
+            # Negated >= (not <) so non-comparable values (NaN) drop
+            # exactly as af_filter's `>= min_af` keep-test does.
+            if not af or not (float(af[0]) >= min_af):
+                continue
+        out = []
+        for c in rec.get("calls", ()):
+            for g in c.get("genotype", ()):
+                if g > 0:
+                    out.append(indexes[c["callset_id"]])
+                    break
+        yield rec, contig, out
+
+
 def _carrying_records(records, indexes, variant_set_id, stats, min_af):
     """The fused ingest fast path over raw records.
 
@@ -217,45 +251,73 @@ def _carrying_records(records, indexes, variant_set_id, stats, min_af):
       explicit "", so "" must stay a wildcard or HTTP round-trips would
       change filtering.)
     """
-    from spark_examples_tpu.genomics.types import normalize_contig
-
-    for rec in records:
-        stored = rec.get("variant_set_id")
-        if variant_set_id and stored and stored != variant_set_id:
-            continue
-        if normalize_contig(rec["reference_name"]) is None:
-            continue
-        stats.add(variants_read=1)
-        if min_af is not None:
-            af = (rec.get("info") or {}).get("AF")
-            # Negated >= (not <) so non-comparable values (NaN) drop
-            # exactly as af_filter's `>= min_af` keep-test does.
-            if not af or not (float(af[0]) >= min_af):
-                continue
-        out = []
-        for c in rec.get("calls", ()):
-            for g in c.get("genotype", ()):
-                if g > 0:
-                    out.append(indexes[c["callset_id"]])
-                    break
+    for _rec, _contig, out in _extracted_records(
+        records, indexes, variant_set_id, stats, min_af
+    ):
         if out:
             yield out
 
 
-def _carrying_variants(variants, indexes, stats, min_af):
-    """Fast-path semantics over already-built Variant objects (the
-    FixtureSource fallback when items are not raw dicts)."""
-    from spark_examples_tpu.genomics.datasets import (
-        af_filter,
-        carrying_sample_indices,
-    )
+def _carrying_keyed_records(records, indexes, variant_set_id, stats, min_af):
+    """(contig, identity payload, carrying indices) triples — the fused
+    MULTI-dataset path: :func:`_carrying_records` plus the cross-dataset
+    identity fields (VariantsPca.scala:62-78).
+
+    Unlike the single-dataset path, variants with NO carrying calls are
+    kept: the reference joins RECORDS, so a variant empty in one dataset
+    still contributes its peers' calls; the empty-drop happens after
+    concatenation (getCallsRdd).
+    """
+    from spark_examples_tpu.genomics.hashing import _identity_payload
+
+    for rec, contig, out in _extracted_records(
+        records, indexes, variant_set_id, stats, min_af
+    ):
+        yield (
+            contig,
+            _identity_payload(
+                contig,
+                rec["start"],
+                rec["end"],
+                rec.get("reference_bases", ""),
+                rec.get("alternate_bases"),
+            ),
+            out,
+        )
+
+
+def _filtered_variants(variants, stats, min_af):
+    """Counted + AF-filtered Variant stream (shared by both object-path
+    fallbacks)."""
+    from spark_examples_tpu.genomics.datasets import af_filter
 
     def counted():
         for v in variants:
             stats.add(variants_read=1)
             yield v
 
-    for v in af_filter(counted(), min_af):
+    return af_filter(counted(), min_af)
+
+
+def _keyed_from_variants(variants, indexes, stats, min_af):
+    """Keyed-triple semantics over built Variant objects (the fallback
+    when items are not raw dicts) — the same triple shape
+    datasets._variant_triples produces."""
+    from spark_examples_tpu.genomics.datasets import _variant_triples
+
+    return _variant_triples(
+        _filtered_variants(variants, stats, min_af), indexes
+    )
+
+
+def _carrying_variants(variants, indexes, stats, min_af):
+    """Fast-path semantics over already-built Variant objects (the
+    FixtureSource fallback when items are not raw dicts)."""
+    from spark_examples_tpu.genomics.datasets import (
+        carrying_sample_indices,
+    )
+
+    for v in _filtered_variants(variants, stats, min_af):
         out = carrying_sample_indices(v, indexes)
         if out:
             yield out
@@ -418,6 +480,29 @@ class FixtureSource:
             )
             return
         yield from _carrying_records(
+            items, indexes, variant_set_id, self.stats,
+            min_allele_frequency,
+        )
+
+    def stream_carrying_keyed(
+        self,
+        variant_set_id: str,
+        shard: Shard,
+        indexes: dict,
+        min_allele_frequency: Optional[float] = None,
+    ):
+        """Fused multi-dataset fast path: (contig, identity payload,
+        carrying indices) triples (see :func:`_carrying_keyed_records`)."""
+        items = self._shard_items(shard)
+        if any(isinstance(i, Variant) for i in items):
+            yield from _keyed_from_variants(
+                self._built(items, variant_set_id),
+                indexes,
+                self.stats,
+                min_allele_frequency,
+            )
+            return
+        yield from _carrying_keyed_records(
             items, indexes, variant_set_id, self.stats,
             min_allele_frequency,
         )
@@ -943,6 +1028,25 @@ class JsonlSource:
             self._csr = _CsrCohort.load_or_build(self.root, self._open)
         yield from self._csr.carrying(
             shard,
+            indexes,
+            variant_set_id,
+            self.stats,
+            min_allele_frequency,
+        )
+
+    def stream_carrying_keyed(
+        self,
+        variant_set_id: str,
+        shard: Shard,
+        indexes: dict,
+        min_allele_frequency: Optional[float] = None,
+    ):
+        """Fused multi-dataset fast path over the parsed-record index
+        (the CSR sidecar keeps no identity fields, so the keyed path
+        reads records — still skipping Call/Variant materialization)."""
+        self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
+        yield from _carrying_keyed_records(
+            self._variants_index().slice(shard),
             indexes,
             variant_set_id,
             self.stats,

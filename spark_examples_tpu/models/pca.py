@@ -167,6 +167,47 @@ class VariantsPcaDriver:
                 self.conf.min_allele_frequency,
             )
 
+    def _fused_multi_possible(self) -> bool:
+        """Keyed fused ingest for multi-dataset join/merge: identity
+        payloads + carrying indices straight from records (no
+        --debug-datasets, source implements stream_carrying_keyed)."""
+        return (
+            len(self.conf.variant_set_ids) > 1
+            and not self.conf.debug_datasets
+            and hasattr(self.source, "stream_carrying_keyed")
+        )
+
+    def get_calls_fused_multi(self) -> Iterator[List[int]]:
+        """Fused multi-dataset ingest: keyed triples per dataset →
+        identity join/merge, same observable behavior as the staged path
+        (parity-tested), without Call/Variant materialization."""
+        from spark_examples_tpu.genomics.datasets import calls_stream_keyed
+
+        shards = self._manifest()
+        unique = _contig_runs_unique(shards)
+        if self.conf.min_allele_frequency is not None:
+            for _ in self.conf.variant_set_ids:
+                # One parity print per dataset (filter_dataset prints per
+                # stream in the staged path).
+                print(
+                    f"Min allele frequency "
+                    f"{self.conf.min_allele_frequency}."
+                )
+
+        def keyed(vsid: str):
+            for shard in shards:
+                yield from self.source.stream_carrying_keyed(
+                    vsid,
+                    shard,
+                    self.index.indexes,
+                    self.conf.min_allele_frequency,
+                )
+
+        return calls_stream_keyed(
+            [keyed(v) for v in self.conf.variant_set_ids],
+            contig_runs_unique=unique,
+        )
+
     @staticmethod
     def _debug_wrap(stream):
         for v in stream:
@@ -579,6 +620,10 @@ class VariantsPcaDriver:
                     g = self.get_similarity_matrix_checkpointed()
                 elif self._fused_ingest_possible():
                     g = self.get_similarity_matrix(self.get_calls_fused())
+                elif self._fused_multi_possible():
+                    g = self.get_similarity_matrix(
+                        self.get_calls_fused_multi()
+                    )
                 else:
                     data = self.get_data()
                     filtered = [self.filter_dataset(d) for d in data]

@@ -288,14 +288,17 @@ struct LineParser {
 
   bool number_i64(int64_t* out) {
     ws();
-    char* e = nullptr;
-    long long v = std::strtoll(p, &e, 10);
-    if (e == p || e > end) {
+    const char* s = p;
+    if (p < end && *p == '-') ++p;
+    const char* d0 = p;
+    while (p < end && *p >= '0' && *p <= '9') ++p;
+    // Strict JSON integer: digits required, no leading zeros — strtoll
+    // alone would accept "012", which json.loads rejects.
+    if (p == d0 || (*d0 == '0' && p - d0 > 1)) {
       err = true;
       return false;
     }
-    p = e;
-    *out = v;
+    *out = std::strtoll(std::string(s, p - s).c_str(), nullptr, 10);
     return true;
   }
 
@@ -306,9 +309,17 @@ struct LineParser {
     if (p < end && *p == '"') {
       std::string s;
       if (!string_exact(&s)) return NAN;
-      char* e = nullptr;
-      double v = std::strtod(s.c_str(), &e);
-      return (e == s.c_str() || *e != '\0') ? NAN : v;
+      // Mirror Python float(str) without reimplementing it: strict JSON
+      // numbers parse, the common missing markers "." and "" map to NaN
+      // (float() raises on them), and anything else — strings float()
+      // might still accept under wider rules ("1_5", " 0.5", "inf") —
+      // refuses the file so the Python parser decides.
+      if (s.empty() || s == ".") return NAN;
+      if (!json_number_valid(s)) {
+        err = true;
+        return NAN;
+      }
+      return std::strtod(s.c_str(), nullptr);
     }
     const char* s = p;
     skip_value();  // validates the bare token (err on invalid JSON)

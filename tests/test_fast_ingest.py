@@ -357,3 +357,151 @@ class TestUnknownCallsetLazy:
                     DEFAULT_VARIANT_SET_ID, bad_shard, index.indexes
                 )
             )
+
+
+class TestFusedMultiDataset:
+    """Keyed fused join/merge ≡ the staged multi-dataset path."""
+
+    def _two_sets(self, src_factory=None):
+        a = synthetic_cohort(8, 60, variant_set_id="setA", seed=1)
+        b = synthetic_cohort(8, 60, variant_set_id="setB", seed=1)
+        merged = FixtureSource(
+            variants=a._variants + b._variants,
+            callsets=a._callsets + b._callsets,
+        )
+        return merged
+
+    def _three_sets(self):
+        srcs = [
+            synthetic_cohort(6, 40, variant_set_id=f"set{i}", seed=1)
+            for i in range(3)
+        ]
+        return FixtureSource(
+            variants=[r for s in srcs for r in s._variants],
+            callsets=[c for s in srcs for c in s._callsets],
+        )
+
+    @pytest.mark.parametrize("min_af", [None, 0.2])
+    def test_driver_join_fused_equals_staged(self, min_af):
+        from spark_examples_tpu.models.pca import VariantsPcaDriver
+        from spark_examples_tpu.utils.config import PcaConfig
+
+        conf = PcaConfig(
+            variant_set_ids=["setA", "setB"],
+            bases_per_partition=20_000,
+            block_variants=32,
+            min_allele_frequency=min_af,
+        )
+        fused_driver = VariantsPcaDriver(conf, self._two_sets())
+        assert fused_driver._fused_multi_possible()
+        fused = fused_driver.run()
+        staged_driver = VariantsPcaDriver(conf, self._two_sets())
+        staged_calls = staged_driver.get_calls(
+            [
+                staged_driver.filter_dataset(d)
+                for d in staged_driver.get_data()
+            ]
+        )
+        g = staged_driver.get_similarity_matrix(staged_calls)
+        staged = staged_driver.compute_pca(g)
+        assert [r[0] for r in fused] == [r[0] for r in staged]
+        np.testing.assert_allclose(
+            np.array([r[1:] for r in fused]),
+            np.array([r[1:] for r in staged]),
+            atol=1e-6,
+        )
+
+    def test_three_set_merge_calls_identical(self):
+        from spark_examples_tpu.models.pca import VariantsPcaDriver
+        from spark_examples_tpu.utils.config import PcaConfig
+
+        conf = PcaConfig(
+            variant_set_ids=["set0", "set1", "set2"],
+            bases_per_partition=20_000,
+            block_variants=32,
+        )
+        fused_driver = VariantsPcaDriver(conf, self._three_sets())
+        fused = sorted(map(tuple, fused_driver.get_calls_fused_multi()))
+        staged_driver = VariantsPcaDriver(conf, self._three_sets())
+        staged = sorted(
+            map(
+                tuple,
+                staged_driver.get_calls(
+                    [
+                        staged_driver.filter_dataset(d)
+                        for d in staged_driver.get_data()
+                    ]
+                ),
+            )
+        )
+        assert fused and fused == staged
+
+    def test_keyed_join_over_http(self, tmp_path):
+        from spark_examples_tpu.genomics.service import (
+            GenomicsServiceServer,
+            HttpVariantSource,
+        )
+        from spark_examples_tpu.models.pca import VariantsPcaDriver
+        from spark_examples_tpu.utils.config import PcaConfig
+
+        server = GenomicsServiceServer(self._two_sets()).start()
+        try:
+            conf = PcaConfig(
+                variant_set_ids=["setA", "setB"],
+                bases_per_partition=20_000,
+                block_variants=32,
+            )
+            remote = VariantsPcaDriver(
+                conf, HttpVariantSource(f"http://127.0.0.1:{server.port}")
+            )
+            assert remote._fused_multi_possible()
+            got = remote.run()
+            local = VariantsPcaDriver(conf, self._two_sets()).run()
+            np.testing.assert_allclose(
+                np.array([r[1:] for r in got]),
+                np.array([r[1:] for r in local]),
+                atol=1e-6,
+            )
+        finally:
+            server.stop()
+
+    def test_keyed_join_over_jsonl(self, tmp_path):
+        from spark_examples_tpu.models.pca import VariantsPcaDriver
+        from spark_examples_tpu.utils.config import PcaConfig
+
+        root = str(tmp_path / "c")
+        self._two_sets().dump(root)
+        conf = PcaConfig(
+            variant_set_ids=["setA", "setB"],
+            bases_per_partition=20_000,
+            block_variants=32,
+        )
+        disk = VariantsPcaDriver(conf, JsonlSource(root)).run()
+        mem = VariantsPcaDriver(conf, self._two_sets()).run()
+        np.testing.assert_allclose(
+            np.array([r[1:] for r in disk]),
+            np.array([r[1:] for r in mem]),
+            atol=1e-6,
+        )
+
+    def test_keyed_duplicate_identity_cross_product(self):
+        from spark_examples_tpu.genomics.datasets import join_keyed
+
+        def triple(contig, payload, calls):
+            return (contig, payload, calls)
+
+        a = [triple("17", b"p1", [0]), triple("17", b"p1", [1])]
+        b = [triple("17", b"p1", [2]), triple("17", b"p2", [3])]
+        out = sorted(join_keyed(iter(a), iter(b)))
+        assert out == [[0, 2], [1, 2]]
+
+    def test_keyed_empty_left_calls_still_join(self):
+        # A record with NO carriers in set A still matches and
+        # contributes B's carriers (reference joins records, not calls).
+        from spark_examples_tpu.genomics.datasets import (
+            calls_stream_keyed,
+        )
+
+        a = [("17", b"p1", [])]
+        b = [("17", b"p1", [4, 5])]
+        assert list(calls_stream_keyed([iter(a), iter(b)])) == [[4, 5]]

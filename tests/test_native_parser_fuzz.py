@@ -113,6 +113,16 @@ def _adversarial_lines(rng):
         '{"reference_name": "17", "start": 7',
         '{"reference_name": "17", "start": 8, "info": {"AF": [0x10]}}',
         "not json at all",
+        # non-JSON integers and float-grammar mismatches
+        '{"reference_name": "17", "start": 012, "calls": []}',
+        '{"reference_name": "17", "start": 1, "calls": '
+        '[{"callset_id": "cs-0", "genotype": [01]}]}',
+        '{"reference_name": "17", "start": 2, "info": {"AF": ["0x10"]}, '
+        '"calls": []}',
+        '{"reference_name": "17", "start": 3, "info": {"AF": ["1_5"]}, '
+        '"calls": []}',
+        '{"reference_name": "17", "start": 4, "info": {"AF": ["."]}, '
+        '"calls": []}',
         # escapes in extracted strings (valid JSON; native must refuse)
         '{"reference_name": "chr\\u005f17", "start": 9, "calls": []}',
         '{"reference_name": "17", "start": 10, "variant_set_id": '
