@@ -50,3 +50,52 @@ def test_pipeline_matches_committed_golden(tmp_path):
             got[name][:2], want[name][:2], atol=1e-6, err_msg=name
         )
         assert got[name][2] == want[name][2]
+
+
+JOIN_GOLDEN = os.path.join(
+    os.path.dirname(__file__), "golden", "join_cohort32_seed4-pca.tsv"
+)
+
+
+def test_two_dataset_join_matches_committed_golden(tmp_path):
+    """Cross-round anchor for the multi-dataset identity join: two
+    identical 32-sample cohorts under different variant-set ids joined
+    through the full driver (--precise), pinned to 1e-6. Sanity property
+    baked into the fixture: each sample's setA/setB twins must land at
+    the same coordinates."""
+    from spark_examples_tpu.genomics.sources import FixtureSource
+
+    a = synthetic_cohort(32, 300, variant_set_id="setA", seed=4)
+    b = synthetic_cohort(32, 300, variant_set_id="setB", seed=4)
+    merged = FixtureSource(
+        variants=a._variants + b._variants,
+        callsets=a._callsets + b._callsets,
+    )
+    conf = PcaConfig(
+        variant_set_ids=["setA", "setB"],
+        precise=True,
+        block_variants=64,
+        output_path=str(tmp_path / "join"),
+    )
+    VariantsPcaDriver(conf, merged).run()
+
+    def load_multi(path):
+        rows = {}
+        with open(path) as f:
+            for line in f:
+                name, pc1, pc2, dataset = line.rstrip("\n").split("\t")
+                rows[(name, dataset)] = (float(pc1), float(pc2))
+        return rows
+
+    got = load_multi(str(tmp_path / "join-pca.tsv"))
+    want = load_multi(JOIN_GOLDEN)
+    assert got.keys() == want.keys()
+    for key in want:
+        np.testing.assert_allclose(
+            got[key], want[key], atol=1e-6, err_msg=str(key)
+        )
+    # Twin-coordinate sanity: the same sample in both sets coincides.
+    for (name, dataset), (pc1, pc2) in got.items():
+        np.testing.assert_allclose(
+            (pc1, pc2), got[(name, "setA")], atol=1e-9, err_msg=name
+        )
