@@ -18,6 +18,57 @@ import textwrap
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.skipif(
+    os.environ.get("SPARK_EXAMPLES_TPU_SKIP_MULTIHOST") == "1",
+    reason="multihost tests disabled",
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_workers(script_path, argv, env_extra=None, n=2, timeout=240):
+    """Spawn n coordinator-connected worker processes and collect logs.
+
+    A dead peer leaves the other blocked in a gloo collective — never
+    leak one past the test (it would hold the port for the session).
+    Asserts every worker exits 0.
+    """
+    port = _free_port()
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ),
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "JAX_NUM_PROCESSES": str(n),
+        **(env_extra or {}),
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script_path)] + [str(a) for a in argv],
+            env={**env, "JAX_PROCESS_ID": str(i)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for i in range(n)
+    ]
+    try:
+        logs = [p.communicate(timeout=timeout)[0].decode() for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, log[-2000:]
+    return logs
+
+
 _WORKER = textwrap.dedent(
     """
     import json, os, sys
@@ -90,41 +141,11 @@ _WORKER = textwrap.dedent(
 )
 
 
-@pytest.mark.skipif(
-    os.environ.get("SPARK_EXAMPLES_TPU_SKIP_MULTIHOST") == "1",
-    reason="multihost test disabled",
-)
 def test_two_process_pipeline_matches_single(tmp_path):
-    port = _free_port()
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
     out_file = tmp_path / "result.json"
-    env = {
-        **os.environ,
-        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-        "JAX_NUM_PROCESSES": "2",
-    }
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(out_file)],
-            env={**env, "JAX_PROCESS_ID": str(i)},
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-        )
-        for i in range(2)
-    ]
-    try:
-        logs = [p.communicate(timeout=240)[0].decode() for p in procs]
-    finally:
-        # A dead peer leaves the other blocked in a gloo collective —
-        # never leak it past the test (it would hold the port for the
-        # rest of the session).
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for p, log in zip(procs, logs):
-        assert p.returncode == 0, log[-2000:]
+    _run_workers(script, [out_file])
     result = json.loads(out_file.read_text())
 
     # Single-process golden over the same cohort/manifest.
@@ -175,14 +196,6 @@ def test_two_process_pipeline_matches_single(tmp_path):
         atol=1e-5,
     )
     assert os.path.exists(str(out_file) + ".driver-pca.tsv")
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 _GLOBAL_MESH_WORKER = textwrap.dedent(
@@ -246,33 +259,14 @@ _GLOBAL_MESH_WORKER = textwrap.dedent(
 def test_global_mesh_gramian_two_processes(tmp_path):
     """Multi-controller GSPMD: one mesh over 2 processes x 4 devices;
     uneven per-host block streams; result equals the dense Gramian."""
-    port = _free_port()
     script = tmp_path / "worker.py"
     script.write_text(_GLOBAL_MESH_WORKER)
     out_file = tmp_path / "result.json"
-    env = {
-        **os.environ,
-        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-        "JAX_NUM_PROCESSES": "2",
-    }
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(out_file)],
-            env={**env, "JAX_PROCESS_ID": str(i), "XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-        )
-        for i in range(2)
-    ]
-    try:
-        logs = [p.communicate(timeout=240)[0].decode() for p in procs]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for p, log in zip(procs, logs):
-        assert p.returncode == 0, log[-2000:]
+    _run_workers(
+        script,
+        [out_file],
+        env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
+    )
     result = json.loads(out_file.read_text())
     assert result["ok"]
 
@@ -295,6 +289,92 @@ def test_global_mesh_gramian_two_processes(tmp_path):
         np.array([r[1:] for r in single]),
         atol=1e-5,
     )
+
+
+_HTTP_INGEST_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from spark_examples_tpu.parallel.distributed import initialize_from_env
+    assert initialize_from_env()
+    from spark_examples_tpu.genomics.fixtures import DEFAULT_VARIANT_SET_ID
+    from spark_examples_tpu.genomics.service import HttpVariantSource
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    pid = jax.process_index()
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,
+        block_variants=32,
+    )
+    # Every process ingests ITS manifest slice from the shared service —
+    # the reference's deployment shape (each executor streams its shards
+    # from the API, VariantsRDD.scala:205-235).
+    source = HttpVariantSource(sys.argv[2])
+    result = VariantsPcaDriver(conf, source).run()
+    if pid == 0:
+        with open(sys.argv[1], "w") as f:
+            json.dump(
+                {"driver_result": [[r[0], r[1], r[2]] for r in result],
+                 "partitions": source.stats.partitions}, f
+            )
+    """
+)
+
+
+def test_two_process_http_ingest(tmp_path):
+    """DP across hosts with NETWORK ingest: two processes each stream
+    their manifest slice from one served cohort and the merged result
+    equals the single-process run over the same service."""
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.genomics.service import (
+        GenomicsServiceServer,
+        HttpVariantSource,
+    )
+
+    server = GenomicsServiceServer(synthetic_cohort(10, 80, seed=5)).start()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        script = tmp_path / "worker.py"
+        script.write_text(_HTTP_INGEST_WORKER)
+        out_file = tmp_path / "result.json"
+        _run_workers(script, [out_file, url])
+        result = json.loads(out_file.read_text())
+
+        from spark_examples_tpu.models.pca import VariantsPcaDriver
+        from spark_examples_tpu.utils.config import PcaConfig
+
+        conf = PcaConfig(
+            variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+            bases_per_partition=20_000,
+            block_variants=32,
+        )
+        single = VariantsPcaDriver(conf, HttpVariantSource(url)).run()
+        np.testing.assert_allclose(
+            np.array(
+                [r[1:] for r in result["driver_result"]], dtype=float
+            ),
+            np.array([r[1:] for r in single]),
+            atol=1e-5,
+        )
+        # Process 0 streamed exactly ITS round-robin manifest slice.
+        from spark_examples_tpu.genomics.shards import (
+            shards_for_references,
+        )
+
+        assert result["partitions"] == len(
+            shards_for_references(conf.references, 20_000)[0::2]
+        )
+    finally:
+        server.stop()
 
 
 _POD_CHECKPOINT_WORKER = textwrap.dedent(
@@ -367,45 +447,21 @@ def test_pod_checkpoint_resume(tmp_path):
     ck_dir = tmp_path / "ck"
 
     def run_phase(phase):
-        port = _free_port()
-        env = {
-            **os.environ,
-            "PYTHONPATH": os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__))
-            ),
-            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-            "JAX_NUM_PROCESSES": "2",
-        }
-        procs = [
-            subprocess.Popen(
-                [sys.executable, str(script), str(out_file), str(ck_dir), phase],
-                env={
-                    **env,
-                    "JAX_PROCESS_ID": str(i),
-                    "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-                },
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-            )
-            for i in range(2)
-        ]
-        try:
-            logs = [p.communicate(timeout=240)[0].decode() for p in procs]
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-        return procs, logs
+        return _run_workers(
+            script,
+            [out_file, ck_dir, phase],
+            env_extra={
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4"
+            },
+        )
 
-    procs, logs = run_phase("fail")
+    logs = run_phase("fail")
     for i in range(2):
         marker = json.loads((tmp_path / f"result.json.phase1.{i}").read_text())
         assert marker["ok"], logs[i][-2000:]
     assert (ck_dir / "host-0").exists() and (ck_dir / "host-1").exists()
 
-    procs, logs = run_phase("resume")
-    for p, log in zip(procs, logs):
-        assert p.returncode == 0, log[-2000:]
+    run_phase("resume")
     result = json.loads(out_file.read_text())
     # Round 1 resumed from its snapshot: the rerun re-streamed fewer
     # shards than the full manifest slice.
@@ -484,37 +540,14 @@ def test_sample_sharded_pod_two_processes(tmp_path):
     """The 100k-stress path at test scale: G sample-sharded P(data, model)
     over a 2-process x 4-device mesh, randomized sharded eig, full driver —
     matches the single-process sample-sharded run."""
-    port = _free_port()
     script = tmp_path / "worker.py"
     script.write_text(_SAMPLE_SHARDED_WORKER)
     out_file = tmp_path / "result.json"
-    env = {
-        **os.environ,
-        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-        "JAX_NUM_PROCESSES": "2",
-    }
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(out_file)],
-            env={
-                **env,
-                "JAX_PROCESS_ID": str(i),
-                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-            },
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-        )
-        for i in range(2)
-    ]
-    try:
-        logs = [p.communicate(timeout=240)[0].decode() for p in procs]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for p, log in zip(procs, logs):
-        assert p.returncode == 0, log[-2000:]
+    _run_workers(
+        script,
+        [out_file],
+        env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
+    )
     result = json.loads(out_file.read_text())
 
     # Single-process golden: same config (sample-sharded + randomized eig)
@@ -611,42 +644,16 @@ def test_two_process_checkpoint_resume(tmp_path):
     ck_dir = tmp_path / "ck"
 
     def run_phase(phase):
-        port = _free_port()
-        env = {
-            **os.environ,
-            "PYTHONPATH": os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__))
-            ),
-            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-            "JAX_NUM_PROCESSES": "2",
-        }
-        procs = [
-            subprocess.Popen(
-                [sys.executable, str(script), str(out_file), str(ck_dir), phase],
-                env={**env, "JAX_PROCESS_ID": str(i)},
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-            )
-            for i in range(2)
-        ]
-        try:
-            logs = [p.communicate(timeout=240)[0].decode() for p in procs]
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-        return procs, logs
+        return _run_workers(script, [out_file, ck_dir, phase])
 
-    procs, logs = run_phase("fail")
+    logs = run_phase("fail")
     for i in range(2):
         marker = json.loads((tmp_path / f"result.json.phase1.{i}").read_text())
         assert marker["ok"], logs[i][-2000:]
     # Both hosts wrote their own snapshots.
     assert (ck_dir / "host-0").exists() and (ck_dir / "host-1").exists()
 
-    procs, logs = run_phase("resume")
-    for p, log in zip(procs, logs):
-        assert p.returncode == 0, log[-2000:]
+    run_phase("resume")
     result = json.loads(out_file.read_text())
     # Host 0 re-streamed nothing on resume (its slice was complete) and
     # host 1 only its remaining shards; stats prove partial re-ingest.
