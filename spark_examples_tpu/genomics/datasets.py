@@ -228,9 +228,14 @@ def _hashed(triples, chunk: int = 65536):
         block = list(itertools.islice(it, chunk))
         if not block:
             return
-        keys = hash_payloads(t[1] for t in block)
-        for key, t in zip(keys, block):
-            yield key, t[2]
+        # A str in the key slot is an ALREADY-HASHED identity (sidecar's
+        # precomputed column); bytes are raw payloads to hash here.
+        keys = hash_payloads(
+            t[1] for t in block if not isinstance(t[1], str)
+        )
+        kit = iter(keys)
+        for t in block:
+            yield (t[1] if isinstance(t[1], str) else next(kit)), t[2]
 
 
 def _triple_contig(t):

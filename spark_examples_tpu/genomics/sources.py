@@ -585,7 +585,7 @@ class _CsrCohort:
     (those consumers need fields the sidecar doesn't keep).
     """
 
-    VERSION = 1
+    VERSION = 2
 
     def __init__(self, data: dict):
         self._d = data
@@ -702,10 +702,10 @@ class _CsrCohort:
             def table(blob_ptr, offs_ptr, n):
                 if n == 0:
                     return []
-                ends = arr(offs_ptr, int(n) + 1, np.int64)
-                raw = ctypes.string_at(blob_ptr, int(ends[-1]))
+                offs = arr(offs_ptr, int(n) + 1, np.int64)
+                raw = ctypes.string_at(blob_ptr, int(offs[-1]))
                 return [
-                    raw[ends[i] : ends[i + 1]].decode()
+                    raw[offs[i] : offs[i + 1]].decode()
                     for i in range(int(n))
                 ]
 
@@ -719,6 +719,9 @@ class _CsrCohort:
                 arr(c.offsets, nv + 1, np.int64),
                 arr(c.ords, nc, np.int32),
                 [],
+                arr(c.ends, nv, np.int64),
+                table(c.ref_blob, c.ref_offs, nv),
+                table(c.alt_blob, c.alt_offs, nv),
             )
         finally:
             lib.cohort_csr_free(res)
@@ -743,6 +746,7 @@ class _CsrCohort:
         vsid_table: List[str] = []
         vsid_of: dict = {}
         rec_contig, starts, rec_vsid, afs = [], [], [], []
+        ends, refs, alts = [], [], []
         offs, ords = [0], []
         with open_fn("variants.jsonl") as f:
             for line in f:
@@ -784,6 +788,23 @@ class _CsrCohort:
                     vsid_table.append(vsid)
                 rec_vsid.append(vsid_of[vsid])
                 starts.append(int(rec["start"]))
+                # Identity fields (KeyError on missing end matches the
+                # staged builder, which requires it). Non-string
+                # ref/alt values make the IDENTITY invalid, not the
+                # record: single-dataset ingest never reads them, and
+                # the keyed path raises lazily only when such a record
+                # is actually served — the same timing as the record
+                # path's TypeError inside the payload builder.
+                ends.append(int(rec["end"]))
+                try:
+                    ref = rec.get("reference_bases") or ""
+                    if not isinstance(ref, str):
+                        raise TypeError(ref)
+                    alt = "".join(rec.get("alternate_bases") or ())
+                except TypeError:
+                    ref = alt = None
+                refs.append(ref)
+                alts.append(alt)
                 afs.append(af_val)
         return (
             contig_table,
@@ -795,6 +816,9 @@ class _CsrCohort:
             np.array(offs, np.int64),
             np.array(ords, np.int32),
             extra_ids,
+            np.array(ends, np.int64),
+            refs,
+            alts,
         )
 
     @staticmethod
@@ -810,6 +834,9 @@ class _CsrCohort:
         offsets,
         ords,
         extra_ids=(),
+        ends=None,
+        refs=None,
+        alts=None,
     ):
         """File-ordered arrays -> per-contig sorted sidecar layout.
 
@@ -875,6 +902,38 @@ class _CsrCohort:
         for r, _cname in enumerate(seg_contigs):
             seg_lo.append(int(np.searchsorted(rr_sorted, r, "left")))
             seg_hi.append(int(np.searchsorted(rr_sorted, r, "right")))
+        # Identity-hash column (cross-dataset join from the sidecar):
+        # murmur3 over the exact payload bytes the staged path hashes
+        # (VariantsPca.scala:62-78), stored as hex so numpy round-trips it.
+        from spark_examples_tpu.genomics.hashing import (
+            _identity_payload,
+            hash_payloads,
+        )
+
+        ends_s = np.asarray(ends)[order].astype(np.int64)
+        contig_names = [seg_contigs[int(r)] for r in rr_sorted.tolist()]
+        keys = []
+        payloads = []
+        slots = []
+        for i, j in enumerate(order.tolist()):
+            if refs[int(j)] is None:
+                # Invalid identity fields: "" sentinel — carrying_keyed
+                # raises lazily if such a record is ever served.
+                keys.append("")
+                continue
+            keys.append(None)
+            slots.append(i)
+            payloads.append(
+                _identity_payload(
+                    contig_names[i],
+                    int(starts_s[i]),
+                    int(ends_s[i]),
+                    refs[int(j)],
+                    [alts[int(j)]] if alts[int(j)] else None,
+                )
+            )
+        for slot, h in zip(slots, hash_payloads(payloads)):
+            keys[slot] = h
         return {
             "digest": np.str_(digest),
             "contigs": str_arr(seg_contigs),
@@ -887,11 +946,41 @@ class _CsrCohort:
             "ords": ords_s,
             "vsids": str_arr(vsid_new),
             "callset_ids": str_arr(list(callset_ids) + list(extra_ids)),
+            "idkeys": np.array(keys, dtype="S32"),
         }
+
+    def has_identity_keys(self) -> bool:
+        return "idkeys" in self._d
+
+    def carrying_keyed(self, shard, indexes, variant_set_id, stats, min_af):
+        """(contig, identity KEY, carrying indices) triples — the keyed
+        fast path served from the sidecar's precomputed hash column.
+        Keys are hex strings; datasets._hashed passes them through
+        unhashed. Empty call lists are KEPT (join semantics)."""
+        for row_abs, calls in self._rows(
+            shard, indexes, variant_set_id, stats, min_af, keep_empty=True
+        ):
+            key = self._d["idkeys"][row_abs].decode()
+            if not key:
+                raise TypeError(
+                    f"record at {shard.contig}:"
+                    f"{int(self._d['starts'][row_abs])} has non-string "
+                    "identity fields (reference/alternate bases); it "
+                    "cannot participate in a cross-dataset join"
+                )
+            yield (_strip_chr(shard.contig), key, calls)
 
     def carrying(self, shard, indexes, variant_set_id, stats, min_af):
         """Per-variant carrying index lists for the shard — semantics of
         :func:`_carrying_records` over the columnar arrays."""
+        for _row, calls in self._rows(
+            shard, indexes, variant_set_id, stats, min_af, keep_empty=False
+        ):
+            yield calls
+
+    def _rows(self, shard, indexes, variant_set_id, stats, min_af,
+              keep_empty):
+        """Shared shard query: yields (absolute row index, calls list)."""
         d = self._d
         seg = self.segments.get(_strip_chr(shard.contig))
         if seg is None:
@@ -932,12 +1021,14 @@ class _CsrCohort:
         for row in np.nonzero(keep)[0].tolist():
             o_lo, o_hi = offsets[a + row], offsets[a + row + 1]
             if o_lo == o_hi:
+                if keep_empty:
+                    yield a + row, []
                 continue
             mapped = lookup[ords[o_lo:o_hi]]
             if (mapped < 0).any():
                 bad = int(ords[o_lo:o_hi][mapped < 0][0])
                 raise KeyError(str(d["callset_ids"][bad]))
-            yield mapped.tolist()
+            yield a + row, mapped.tolist()
 
 
 class JsonlSource:
@@ -1041,10 +1132,21 @@ class JsonlSource:
         indexes: dict,
         min_allele_frequency: Optional[float] = None,
     ):
-        """Fused multi-dataset fast path over the parsed-record index
-        (the CSR sidecar keeps no identity fields, so the keyed path
-        reads records — still skipping Call/Variant materialization)."""
+        """Fused multi-dataset fast path: served from the sidecar's
+        precomputed identity-hash column when available (format v2+),
+        else from the parsed-record index."""
         self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
+        if self._csr is None:
+            self._csr = _CsrCohort.load_or_build(self.root, self._open)
+        if self._csr.has_identity_keys():
+            yield from self._csr.carrying_keyed(
+                shard,
+                indexes,
+                variant_set_id,
+                self.stats,
+                min_allele_frequency,
+            )
+            return
         yield from _carrying_keyed_records(
             self._variants_index().slice(shard),
             indexes,

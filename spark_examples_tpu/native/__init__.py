@@ -28,6 +28,7 @@ class CohortCsr(ctypes.Structure):
         ("error", ctypes.c_int64),
         ("error_line", ctypes.c_int64),
         ("starts", ctypes.POINTER(ctypes.c_int64)),
+        ("ends", ctypes.POINTER(ctypes.c_int64)),
         ("contig_code", ctypes.POINTER(ctypes.c_int32)),
         ("vsid_code", ctypes.POINTER(ctypes.c_int32)),
         ("afs", ctypes.POINTER(ctypes.c_double)),
@@ -37,6 +38,10 @@ class CohortCsr(ctypes.Structure):
         ("contig_offs", ctypes.POINTER(ctypes.c_int64)),
         ("vsid_blob", ctypes.POINTER(ctypes.c_char)),
         ("vsid_offs", ctypes.POINTER(ctypes.c_int64)),
+        ("ref_blob", ctypes.POINTER(ctypes.c_char)),
+        ("ref_offs", ctypes.POINTER(ctypes.c_int64)),
+        ("alt_blob", ctypes.POINTER(ctypes.c_char)),
+        ("alt_offs", ctypes.POINTER(ctypes.c_int64)),
     ]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -120,9 +125,16 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_uint64,
             ctypes.c_void_p,
         ]
-        if hasattr(lib, "parse_cohort_jsonl"):
-            # A deployed tree may ship an older .so without the parser;
-            # the original entry points must keep working regardless.
+        # Bind the cohort parser only when the library's struct layout
+        # matches this module's ctypes mirror: a deployed tree may ship
+        # an older .so, and reading an old struct through a newer layout
+        # would silently misalign every pointer after the changed field.
+        _ABI = 2
+        abi_ok = False
+        if hasattr(lib, "cohort_csr_abi_version"):
+            lib.cohort_csr_abi_version.restype = ctypes.c_int64
+            abi_ok = lib.cohort_csr_abi_version() == _ABI
+        if abi_ok and hasattr(lib, "parse_cohort_jsonl"):
             lib.parse_cohort_jsonl.argtypes = [
                 ctypes.c_char_p,
                 ctypes.c_void_p,

@@ -375,6 +375,7 @@ typedef struct CohortCsr {
   int64_t error;
   int64_t error_line;
   const int64_t* starts;
+  const int64_t* ends;
   const int32_t* contig_code;
   const int32_t* vsid_code;
   const double* afs;
@@ -384,6 +385,12 @@ typedef struct CohortCsr {
   const int64_t* contig_offs;
   const char* vsid_blob;
   const int64_t* vsid_offs;
+  // Per-record identity fields (cross-dataset join): reference bases and
+  // concatenated alternate bases, offsets length n_variants + 1.
+  const char* ref_blob;
+  const int64_t* ref_offs;
+  const char* alt_blob;
+  const int64_t* alt_offs;
 } CohortCsr;
 
 }  // extern "C"
@@ -393,6 +400,7 @@ namespace {
 struct CohortImpl {
   CohortCsr view{};
   std::vector<int64_t> starts;
+  std::vector<int64_t> ends;
   std::vector<int32_t> contig_code;
   std::vector<int32_t> vsid_code;
   std::vector<double> afs;
@@ -400,6 +408,10 @@ struct CohortImpl {
   std::vector<int32_t> ords;
   Interner contigs;
   Interner vsids;
+  std::string ref_blob;
+  std::vector<int64_t> ref_offs{0};
+  std::string alt_blob;
+  std::vector<int64_t> alt_offs{0};
 
   void finalize() {
     view.n_variants = static_cast<int64_t>(starts.size());
@@ -407,6 +419,7 @@ struct CohortImpl {
     view.n_contigs = static_cast<int64_t>(contigs.codes.size());
     view.n_vsids = static_cast<int64_t>(vsids.codes.size());
     view.starts = starts.data();
+    view.ends = ends.data();
     view.contig_code = contig_code.data();
     view.vsid_code = vsid_code.data();
     view.afs = afs.data();
@@ -416,6 +429,10 @@ struct CohortImpl {
     view.contig_offs = contigs.offs.data();
     view.vsid_blob = vsids.blob.data();
     view.vsid_offs = vsids.offs.data();
+    view.ref_blob = ref_blob.data();
+    view.ref_offs = ref_offs.data();
+    view.alt_blob = alt_blob.data();
+    view.alt_offs = alt_offs.data();
   }
 };
 
@@ -426,9 +443,12 @@ bool parse_line(const char* line, const char* line_end, CohortImpl* out,
   if (!lp.eat('{')) return false;
   std::string contig;
   bool contig_seen = false, dropped = false;
-  int64_t start = 0;
-  bool start_seen = false;
+  int64_t start = 0, end_pos = 0;
+  bool start_seen = false, end_seen = false;
   std::string vsid;
+  std::string ref_bases;
+  std::string alt_concat;
+  bool ref_seen = false, alt_seen = false;
   double af = NAN;
   std::vector<int32_t> row_ords;
   // json.loads applies last-wins to duplicate keys; the native parser
@@ -460,6 +480,62 @@ bool parse_line(const char* line, const char* line_end, CohortImpl* out,
       }
       if (!lp.number_i64(&start)) return false;
       start_seen = true;
+    } else if (key == "end") {
+      if (end_seen) {
+        lp.err = true;
+        return false;
+      }
+      if (!lp.number_i64(&end_pos)) return false;
+      end_seen = true;
+    } else if (key == "reference_bases") {
+      if (ref_seen) {
+        lp.err = true;
+        return false;
+      }
+      if (lp.peek('"')) {
+        if (!lp.string_exact(&ref_bases)) return false;
+      } else if (lp.peek('n')) {
+        lp.skip_value();  // null -> "" (payload semantics)
+      } else {
+        // Non-schema type (number/bool/object): the Python paths treat
+        // these as invalid identities — fall back, never coerce.
+        lp.err = true;
+        return false;
+      }
+      ref_seen = true;
+    } else if (key == "alternate_bases") {
+      if (alt_seen) {
+        lp.err = true;
+        return false;
+      }
+      alt_seen = true;
+      lp.ws();
+      if (lp.p < lp.end && *lp.p == '[') {
+        ++lp.p;
+        if (lp.peek(']')) {
+          ++lp.p;
+        } else {
+          while (!lp.err) {
+            std::string alt;
+            if (!lp.string_exact(&alt)) return false;
+            alt_concat += alt;  // payload concatenates alternates
+            lp.ws();
+            if (lp.p < lp.end && *lp.p == ',') {
+              ++lp.p;
+              continue;
+            }
+            lp.eat(']');
+            break;
+          }
+        }
+      } else if (lp.peek('n')) {
+        lp.skip_value();  // null -> "" (payload semantics)
+      } else {
+        // A bare string/number here diverges from Python's join
+        // semantics — refuse, never coerce.
+        lp.err = true;
+        return false;
+      }
     } else if (key == "variant_set_id") {
       if (seen_vsid) {
         lp.err = true;
@@ -625,12 +701,17 @@ bool parse_line(const char* line, const char* line_end, CohortImpl* out,
   if (lp.p != lp.end) {  // trailing garbage on the line
     return false;
   }
-  if (!contig_seen || !start_seen) return false;
+  if (!contig_seen || !start_seen || !end_seen) return false;
   if (dropped) return true;  // non-numeric contig: skip, no error
   out->contig_code.push_back(out->contigs.intern(contig));
   out->starts.push_back(start);
+  out->ends.push_back(end_pos);
   out->vsid_code.push_back(out->vsids.intern(vsid));
   out->afs.push_back(af);
+  out->ref_blob += ref_bases;
+  out->ref_offs.push_back(static_cast<int64_t>(out->ref_blob.size()));
+  out->alt_blob += alt_concat;
+  out->alt_offs.push_back(static_cast<int64_t>(out->alt_blob.size()));
   out->ords.insert(out->ords.end(), row_ords.begin(), row_ords.end());
   out->offsets.push_back(static_cast<int64_t>(out->ords.size()));
   return true;
@@ -723,5 +804,10 @@ CohortCsr* parse_cohort_jsonl(const char* path, const uint8_t* callset_blob,
 void cohort_csr_free(CohortCsr* c) {
   delete reinterpret_cast<CohortImpl*>(c);
 }
+
+// Struct-layout handshake: the loader binds parse_cohort_jsonl only when
+// this matches its expected value, so a stale deployed .so can never be
+// read through a newer (misaligned) ctypes layout.
+int64_t cohort_csr_abi_version() { return 2; }
 
 }  // extern "C"

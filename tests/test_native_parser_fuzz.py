@@ -61,14 +61,18 @@ def _random_record(rng):
         rec["reference_name"] = rng.choice(CONTIG_POOL)
     if rng.random() < 0.95:
         rec["start"] = rng.randrange(0, 10_000_000)
-    if rng.random() < 0.5:
+    if rng.random() < 0.95:
         rec["end"] = rng.randrange(0, 10_000_000)
     if rng.random() < 0.6:
         rec["variant_set_id"] = rng.choice(VSID_POOL)
     if rng.random() < 0.5:
         rec["reference_bases"] = rng.choice(["A", "N", "ACGT", ""])
     if rng.random() < 0.4:
-        rec["alternate_bases"] = rng.choice([["G"], ["G", "T"], [], None])
+        rec["alternate_bases"] = rng.choice(
+            [["G"], ["G", "T"], [], None, "AC", [None], 5]
+        )
+    if rng.random() < 0.1:
+        rec["reference_bases"] = rng.choice([None, True, 7])
     if rng.random() < 0.6:
         info = {}
         if rng.random() < 0.8:
@@ -175,6 +179,9 @@ def _compare(tmp_path, lines, tag):
             "offsets",
             "ords",
             "extra_ids",
+            "ends",
+            "refs",
+            "alts",
         ),
         native,
         python,
