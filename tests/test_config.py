@@ -72,3 +72,25 @@ def test_stage_timer_report():
         pass
     rep = t.report()
     assert "a:" in rep and "b:" in rep and "total:" in rep
+
+
+class TestSampleShardedFlag:
+    def test_tri_state(self):
+        import argparse
+
+        from spark_examples_tpu.utils.config import (
+            add_pca_flags,
+            pca_config_from_args,
+        )
+
+        p = argparse.ArgumentParser()
+        add_pca_flags(p)
+        assert pca_config_from_args(
+            p.parse_args([])
+        ).sample_sharded is None
+        assert pca_config_from_args(
+            p.parse_args(["--sample-sharded"])
+        ).sample_sharded is True
+        assert pca_config_from_args(
+            p.parse_args(["--no-sample-sharded"])
+        ).sample_sharded is False

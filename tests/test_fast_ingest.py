@@ -505,3 +505,77 @@ class TestFusedMultiDataset:
         a = [("17", b"p1", [])]
         b = [("17", b"p1", [4, 5])]
         assert list(calls_stream_keyed([iter(a), iter(b)])) == [[4, 5]]
+
+
+class TestSidecarRecovery:
+    def test_corrupt_sidecar_rebuilds(self, tmp_path):
+        import os
+
+        root = str(tmp_path / "c")
+        _cohort().dump(root)
+        shards = shards_for_references(REFS, 20_000)
+        index = CallsetIndex.from_source(
+            JsonlSource(root), [DEFAULT_VARIANT_SET_ID]
+        )
+        want = _fast(
+            JsonlSource(root), DEFAULT_VARIANT_SET_ID, shards, index.indexes, None
+        )
+        sidecar = os.path.join(root, ".variants.csr.npz")
+        # Truncate to garbage: np.load raises BadZipFile, which must
+        # trigger a rebuild, not a crash.
+        with open(sidecar, "wb") as f:
+            f.write(b"PK\x03\x04 not a real zip")
+        got = _fast(
+            JsonlSource(root), DEFAULT_VARIANT_SET_ID, shards, index.indexes, None
+        )
+        assert got == want
+
+    def test_version_mismatch_rebuilds(self, tmp_path):
+        import os
+
+        import numpy as _np
+
+        root = str(tmp_path / "c")
+        _cohort().dump(root)
+        shards = shards_for_references(REFS, 20_000)
+        index = CallsetIndex.from_source(
+            JsonlSource(root), [DEFAULT_VARIANT_SET_ID]
+        )
+        want = _fast(
+            JsonlSource(root), DEFAULT_VARIANT_SET_ID, shards, index.indexes, None
+        )
+        sidecar = os.path.join(root, ".variants.csr.npz")
+        # A structurally-valid npz from an older format version: the
+        # digest embeds the version, so it must be rejected and rebuilt.
+        _np.savez(sidecar, digest=_np.str_("v1|stale"))
+        os.replace(sidecar + ".npz" if os.path.exists(sidecar + ".npz") else sidecar, sidecar)
+        got = _fast(
+            JsonlSource(root), DEFAULT_VARIANT_SET_ID, shards, index.indexes, None
+        )
+        assert got == want
+
+
+class TestRelayHelper:
+    def test_no_axon_site_is_noop(self, monkeypatch):
+        from spark_examples_tpu.utils import relay
+
+        monkeypatch.setattr(relay, "AXON_SITE", "/nonexistent-axon")
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        assert not relay.axon_possible()
+        assert not relay.cpu_failover_if_dead()
+
+    def test_explicit_cpu_is_noop(self, monkeypatch):
+        from spark_examples_tpu.utils import relay
+
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        assert not relay.cpu_failover_if_dead()
+
+    def test_dead_relay_engages(self, monkeypatch, tmp_path):
+        from spark_examples_tpu.utils import relay
+
+        monkeypatch.setattr(relay, "AXON_SITE", str(tmp_path))  # exists
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.setattr(relay, "relay_alive", lambda timeout=5.0: False)
+        assert relay.cpu_failover_if_dead()
+        monkeypatch.setattr(relay, "relay_alive", lambda timeout=5.0: True)
+        assert not relay.cpu_failover_if_dead()
