@@ -547,8 +547,8 @@ class TestSidecarRecovery:
         sidecar = os.path.join(root, ".variants.csr.npz")
         # A structurally-valid npz from an older format version: the
         # digest embeds the version, so it must be rejected and rebuilt.
-        _np.savez(sidecar, digest=_np.str_("v1|stale"))
-        os.replace(sidecar + ".npz" if os.path.exists(sidecar + ".npz") else sidecar, sidecar)
+        with open(sidecar, "wb") as f:
+            _np.savez(f, digest=_np.str_("v1|stale"))
         got = _fast(
             JsonlSource(root), DEFAULT_VARIANT_SET_ID, shards, index.indexes, None
         )
@@ -564,10 +564,20 @@ class TestRelayHelper:
         assert not relay.axon_possible()
         assert not relay.cpu_failover_if_dead()
 
-    def test_explicit_cpu_is_noop(self, monkeypatch):
+    def test_explicit_cpu_is_noop(self, monkeypatch, tmp_path):
         from spark_examples_tpu.utils import relay
 
+        # Axon IS possible here — the explicit-cpu guard must short-
+        # circuit before any relay probe.
+        monkeypatch.setattr(relay, "AXON_SITE", str(tmp_path))
         monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.setattr(
+            relay,
+            "relay_alive",
+            lambda timeout=5.0: (_ for _ in ()).throw(
+                AssertionError("must not probe when platform is cpu")
+            ),
+        )
         assert not relay.cpu_failover_if_dead()
 
     def test_dead_relay_engages(self, monkeypatch, tmp_path):
