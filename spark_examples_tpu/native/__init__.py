@@ -64,6 +64,7 @@ def _build() -> bool:
         "-shared",
         "-fPIC",
         "-std=c++17",
+        "-pthread",
         _SRC,
         "-o",
         tmp,

@@ -136,7 +136,9 @@ void murmur3_x64_128_batch(const uint8_t* data, const int64_t* offsets,
 
 #include <cmath>
 #include <cstdio>
+#include <cstdlib>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -719,6 +721,137 @@ bool parse_line(const char* line, const char* line_end, CohortImpl* out,
 
 }  // namespace
 
+namespace {
+
+// Parse the byte range [begin, range_end) of the file (range_end < 0 =
+// to EOF). Non-final ranges end immediately after a newline (the caller
+// aligns them), so every line is complete. Returns 0 ok, 1 parse
+// anomaly, 2 IO error; *lines counts lines consumed.
+int parse_range(const char* path, int64_t begin, int64_t range_end,
+                CohortImpl* impl,
+                const std::unordered_map<std::string, int32_t>& ord_of,
+                int64_t* lines) {
+  FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) return 2;
+  if (begin > 0 && std::fseek(f, static_cast<long>(begin), SEEK_SET) != 0) {
+    std::fclose(f);
+    return 2;
+  }
+  const size_t CHUNK = 8 << 20;
+  int64_t budget =
+      range_end < 0 ? -1 : range_end - begin;  // -1 = unbounded
+  std::vector<char> buf;
+  size_t have = 0;
+  int64_t line_no = 0;
+  bool eof = false;
+  while (!eof || have > 0) {
+    size_t want = CHUNK;
+    if (budget >= 0 && static_cast<int64_t>(want) > budget) {
+      want = static_cast<size_t>(budget);
+    }
+    buf.resize(have + want + 1);
+    size_t got = want ? std::fread(buf.data() + have, 1, want, f) : 0;
+    if (got < want && std::ferror(f)) {
+      // A mid-file read error must not masquerade as EOF: a silently
+      // truncated parse would be cached as a valid sidecar.
+      std::fclose(f);
+      return 2;
+    }
+    if (budget >= 0) budget -= static_cast<int64_t>(got);
+    eof = (budget == 0) || got < want;
+    have += got;
+    size_t sentinel_pos = SIZE_MAX;
+    if (eof) {
+      // Sentinel newline: terminates a final unterminated line (an extra
+      // blank line is skipped below) and guarantees every strtoll/strtod
+      // inside a line stops before leaving initialized data.
+      buf.resize(have + 1);
+      buf[have] = '\n';
+      sentinel_pos = have;
+      have += 1;
+    }
+    size_t line_start = 0;
+    for (;;) {
+      const char* nl = static_cast<const char*>(
+          memchr(buf.data() + line_start, '\n', have - line_start));
+      if (nl == nullptr) break;
+      const char* line = buf.data() + line_start;
+      const char* line_end = nl;
+      // The empty line "terminated" by the sentinel is not data — it
+      // must not shift line numbers (merged threaded counts would
+      // overshoot by one per range).
+      const bool synthetic =
+          static_cast<size_t>(nl - buf.data()) == sentinel_pos &&
+          line == line_end;
+      if (!synthetic) ++line_no;
+      bool blank = true;
+      for (const char* q = line; q < line_end; ++q) {
+        if (*q != ' ' && *q != '\t' && *q != '\r') {
+          blank = false;
+          break;
+        }
+      }
+      if (!blank && !parse_line(line, line_end, impl, ord_of)) {
+        std::fclose(f);
+        *lines = line_no;
+        return 1;
+      }
+      line_start = static_cast<size_t>(nl - buf.data()) + 1;
+      if (line_start >= have) break;
+    }
+    if (line_start > 0) {
+      std::memmove(buf.data(), buf.data() + line_start, have - line_start);
+      have -= line_start;
+    }
+    if (eof) break;
+  }
+  std::fclose(f);
+  *lines = line_no;
+  return 0;
+}
+
+// Append src's arrays onto dst, re-coding interned contig/vsid ids
+// through dst's interners — chunk order makes the merged tables equal to
+// a sequential parse's first-encounter order, so threading is
+// bit-invisible in the output.
+void merge_chunk(CohortImpl* dst, const CohortImpl& src) {
+  std::vector<int32_t> cmap(src.contigs.codes.size());
+  for (size_t i = 0; i + 1 < src.contigs.offs.size(); ++i) {
+    cmap[i] = dst->contigs.intern(std::string(
+        src.contigs.blob.data() + src.contigs.offs[i],
+        static_cast<size_t>(src.contigs.offs[i + 1] - src.contigs.offs[i])));
+  }
+  std::vector<int32_t> vmap(src.vsids.codes.size());
+  for (size_t i = 0; i + 1 < src.vsids.offs.size(); ++i) {
+    vmap[i] = dst->vsids.intern(std::string(
+        src.vsids.blob.data() + src.vsids.offs[i],
+        static_cast<size_t>(src.vsids.offs[i + 1] - src.vsids.offs[i])));
+  }
+  for (int32_t c : src.contig_code) dst->contig_code.push_back(cmap[c]);
+  for (int32_t v : src.vsid_code) dst->vsid_code.push_back(vmap[v]);
+  dst->starts.insert(dst->starts.end(), src.starts.begin(),
+                     src.starts.end());
+  dst->ends.insert(dst->ends.end(), src.ends.begin(), src.ends.end());
+  dst->afs.insert(dst->afs.end(), src.afs.begin(), src.afs.end());
+  const int64_t ord_base = static_cast<int64_t>(dst->ords.size());
+  dst->ords.insert(dst->ords.end(), src.ords.begin(), src.ords.end());
+  for (size_t i = 1; i < src.offsets.size(); ++i) {
+    dst->offsets.push_back(src.offsets[i] + ord_base);
+  }
+  const int64_t ref_base = static_cast<int64_t>(dst->ref_blob.size());
+  dst->ref_blob += src.ref_blob;
+  for (size_t i = 1; i < src.ref_offs.size(); ++i) {
+    dst->ref_offs.push_back(src.ref_offs[i] + ref_base);
+  }
+  const int64_t alt_base = static_cast<int64_t>(dst->alt_blob.size());
+  dst->alt_blob += src.alt_blob;
+  for (size_t i = 1; i < src.alt_offs.size(); ++i) {
+    dst->alt_offs.push_back(src.alt_offs[i] + alt_base);
+  }
+}
+
+}  // namespace
+
 extern "C" {
 
 CohortCsr* parse_cohort_jsonl(const char* path, const uint8_t* callset_blob,
@@ -735,68 +868,120 @@ CohortCsr* parse_cohort_jsonl(const char* path, const uint8_t* callset_blob,
         static_cast<int32_t>(i));
   }
 
-  FILE* f = std::fopen(path, "rb");
-  if (f == nullptr) {
-    impl->view.error = 2;
+  // Thread count: hardware up to 8 (merge is cheap; parse scales), one
+  // range per >=32MB so small files stay sequential. Env override
+  // SPARK_EXAMPLES_TPU_PARSE_THREADS for tests/tuning.
+  int64_t size = -1;
+  {
+    FILE* f = std::fopen(path, "rb");
+    if (f != nullptr) {
+      if (std::fseek(f, 0, SEEK_END) == 0) size = std::ftell(f);
+      std::fclose(f);
+    }
+  }
+  int threads = 0;
+  bool forced = false;
+  if (const char* env = std::getenv("SPARK_EXAMPLES_TPU_PARSE_THREADS")) {
+    threads = std::atoi(env);
+    forced = threads > 0;  // explicit override skips the size clamp so
+                           // tests can exercise the threaded path on
+                           // small fixtures
+  }
+  if (threads <= 0) {
+    threads = static_cast<int>(std::thread::hardware_concurrency());
+    if (threads > 8) threads = 8;
+  }
+  if (!forced && size >= 0) {
+    const int64_t per = size / (32 << 20);
+    if (per < threads) threads = static_cast<int>(per);
+  }
+  if (threads < 1) threads = 1;
+
+  if (threads == 1 || size <= 0) {
+    int64_t lines = 0;
+    int rc = parse_range(path, 0, -1, impl, ord_of, &lines);
+    if (rc != 0) {
+      impl->view.error = rc;
+      impl->view.error_line = rc == 1 ? lines : -1;
+    }
     impl->finalize();
     return &impl->view;
   }
-  std::vector<char> buf;
-  size_t have = 0;
-  int64_t line_no = 0;
-  bool eof = false;
-  while (!eof || have > 0) {
-    buf.resize(have + (8 << 20) + 1);
-    size_t got = std::fread(buf.data() + have, 1, 8 << 20, f);
-    if (got < static_cast<size_t>(8 << 20) && std::ferror(f)) {
-      // A mid-file read error must not masquerade as EOF: a silently
-      // truncated parse would be cached as a valid sidecar.
-      std::fclose(f);
+
+  // Split at line boundaries: advance each target offset to just past
+  // the next newline.
+  std::vector<int64_t> bounds{0};
+  {
+    FILE* f = std::fopen(path, "rb");
+    if (f == nullptr) {
       impl->view.error = 2;
       impl->finalize();
       return &impl->view;
     }
-    eof = got < static_cast<size_t>(8 << 20);
-    have += got;
-    if (eof) {
-      // Sentinel newline: terminates a final unterminated line (an extra
-      // blank line is skipped below) and guarantees every strtoll/strtod
-      // inside a line stops before leaving initialized data.
-      buf[have] = '\n';
-      have += 1;
+    std::vector<char> probe(1 << 20);
+    for (int i = 1; i < threads; ++i) {
+      int64_t target = size * i / threads;
+      if (target <= bounds.back()) continue;
+      if (std::fseek(f, static_cast<long>(target), SEEK_SET) != 0) break;
+      size_t got = std::fread(probe.data(), 1, probe.size(), f);
+      const char* nl =
+          static_cast<const char*>(memchr(probe.data(), '\n', got));
+      if (nl == nullptr) continue;  // giant line: fold into next range
+      bounds.push_back(target + (nl - probe.data()) + 1);
     }
-    size_t line_start = 0;
-    for (;;) {
-      const char* nl = static_cast<const char*>(
-          memchr(buf.data() + line_start, '\n', have - line_start));
-      if (nl == nullptr) break;
-      const char* line = buf.data() + line_start;
-      const char* line_end = nl;
-      ++line_no;
-      bool blank = true;
-      for (const char* q = line; q < line_end; ++q) {
-        if (*q != ' ' && *q != '\t' && *q != '\r') {
-          blank = false;
-          break;
-        }
-      }
-      if (!blank && !parse_line(line, line_end, impl, ord_of)) {
-        std::fclose(f);
-        impl->view.error = 1;
-        impl->view.error_line = line_no;
-        impl->finalize();
-        return &impl->view;
-      }
-      line_start = static_cast<size_t>(nl - buf.data()) + 1;
-      if (line_start >= have) break;
-    }
-    if (line_start > 0) {
-      std::memmove(buf.data(), buf.data() + line_start, have - line_start);
-      have -= line_start;
-    }
-    if (eof) break;
+    std::fclose(f);
   }
-  std::fclose(f);
+  bounds.push_back(-1);  // last range: to EOF
+
+  const size_t n_ranges = bounds.size() - 1;
+  std::vector<CohortImpl> chunks(n_ranges);
+  std::vector<int> rcs(n_ranges, 0);
+  std::vector<int64_t> lines(n_ranges, 0);
+  std::vector<std::thread> workers;
+  workers.reserve(n_ranges);
+  for (size_t i = 0; i < n_ranges; ++i) {
+    workers.emplace_back([&, i]() {
+      rcs[i] = parse_range(path, bounds[i], bounds[i + 1], &chunks[i],
+                           ord_of, &lines[i]);
+    });
+  }
+  for (auto& w : workers) w.join();
+  for (size_t i = 0; i < n_ranges; ++i) {
+    if (rcs[i] != 0) {
+      impl->view.error = rcs[i];
+      // Global line number: lines of completed ranges before the
+      // failing one plus its local count.
+      int64_t base = 0;
+      for (size_t j = 0; j < i; ++j) base += lines[j];
+      impl->view.error_line = rcs[i] == 1 ? base + lines[i] : -1;
+      impl->finalize();
+      return &impl->view;
+    }
+  }
+  {
+    size_t nv = 0, nords = 0, nref = 0, nalt = 0;
+    for (const auto& c : chunks) {
+      nv += c.starts.size();
+      nords += c.ords.size();
+      nref += c.ref_blob.size();
+      nalt += c.alt_blob.size();
+    }
+    impl->starts.reserve(nv);
+    impl->ends.reserve(nv);
+    impl->contig_code.reserve(nv);
+    impl->vsid_code.reserve(nv);
+    impl->afs.reserve(nv);
+    impl->offsets.reserve(nv + 1);
+    impl->ords.reserve(nords);
+    impl->ref_offs.reserve(nv + 1);
+    impl->alt_offs.reserve(nv + 1);
+    impl->ref_blob.reserve(nref);
+    impl->alt_blob.reserve(nalt);
+  }
+  for (auto& chunk : chunks) {
+    merge_chunk(impl, chunk);
+    chunk = CohortImpl{};  // free as we go: peak ~= data + one chunk
+  }
   impl->finalize();
   return &impl->view;
 }

@@ -202,3 +202,52 @@ class TestNativeCohortParser:
         assert list(
             js.stream_carrying(DEFAULT_VARIANT_SET_ID, shard, index.indexes)
         )
+
+    def test_threaded_parse_matches_sequential(self, tmp_path, monkeypatch):
+        """SPARK_EXAMPLES_TPU_PARSE_THREADS forces the range-split path
+        even on tiny fixtures; output must be bit-identical to the
+        sequential parse (same intern order, same CSR layout)."""
+        import json
+
+        import numpy as np
+        import pytest
+
+        from spark_examples_tpu.genomics.sources import (
+            JsonlSource,
+            _CsrCohort,
+        )
+        from spark_examples_tpu.native import load
+
+        if load() is None or not hasattr(load(), "parse_cohort_jsonl"):
+            pytest.skip("native core unavailable")
+        root = self._dump(tmp_path)
+        js = JsonlSource(root)
+        with js._open("callsets.json") as f:
+            ids = [r["id"] for r in json.load(f)]
+        monkeypatch.setenv("SPARK_EXAMPLES_TPU_PARSE_THREADS", "1")
+        seq = _CsrCohort._parse_native(root, ids)
+        monkeypatch.setenv("SPARK_EXAMPLES_TPU_PARSE_THREADS", "5")
+        par = _CsrCohort._parse_native(root, ids)
+        assert seq is not None and par is not None
+        for name, a, b in zip(
+            (
+                "contig_table",
+                "rec_contig",
+                "starts",
+                "vsid_table",
+                "rec_vsid",
+                "afs",
+                "offsets",
+                "ords",
+                "extra_ids",
+                "ends",
+                "refs",
+                "alts",
+            ),
+            seq,
+            par,
+        ):
+            if isinstance(a, list):
+                assert a == b, name
+            else:
+                np.testing.assert_array_equal(a, b, err_msg=name)
