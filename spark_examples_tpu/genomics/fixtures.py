@@ -19,6 +19,9 @@ from spark_examples_tpu.genomics.shards import BRCA1_REFERENCES, parse_reference
 
 __all__ = [
     "synthetic_cohort",
+    "cohort_record_stream",
+    "cohort_callsets",
+    "dump_cohort_stream",
     "synthetic_reads",
     "synthetic_tumor_normal",
     "DEFAULT_VARIANT_SET_ID",
@@ -70,9 +73,28 @@ def synthetic_cohort(
     (non-carrying calls never reach the Gramian; N comes from the callset
     index, not from call lists). Dense is the default for realism.
     """
-    rng = np.random.default_rng(seed)
-    regions = parse_references(references)
-    callsets = [
+    callsets = cohort_callsets(n_samples, variant_set_id)
+    return FixtureSource(
+        variants=list(
+            cohort_record_stream(
+                n_samples,
+                n_variants,
+                references=references,
+                variant_set_id=variant_set_id,
+                seed=seed,
+                population_structure=population_structure,
+                dropped_contig_every=dropped_contig_every,
+                reference_blocks_every=reference_blocks_every,
+                sparse_calls=sparse_calls,
+            )
+        ),
+        callsets=callsets,
+        stats=stats,
+    )
+
+
+def cohort_callsets(n_samples: int, variant_set_id: str) -> List[Callset]:
+    return [
         Callset(
             id=f"{variant_set_id}-{i}",
             name=_sample_name(i),
@@ -80,13 +102,34 @@ def synthetic_cohort(
         )
         for i in range(n_samples)
     ]
+
+
+def cohort_record_stream(
+    n_samples: int,
+    n_variants: int,
+    references: str = BRCA1_REFERENCES,
+    variant_set_id: str = DEFAULT_VARIANT_SET_ID,
+    seed: int = 0,
+    population_structure: int = 2,
+    dropped_contig_every: Optional[int] = None,
+    reference_blocks_every: Optional[int] = None,
+    sparse_calls: bool = False,
+):
+    """The cohort generator as a RECORD STREAM — O(1) memory, so
+    BASELINE-#4-scale cohorts (millions of variants, tens of GB of
+    records) can be written straight to disk. Identical RNG consumption
+    to the in-memory path (:func:`synthetic_cohort` wraps this), so
+    seeded cohorts and goldens are unchanged.
+    """
+    rng = np.random.default_rng(seed)
+    regions = parse_references(references)
+    callsets = cohort_callsets(n_samples, variant_set_id)
     ids = [c.id for c in callsets]
     names = [c.name for c in callsets]
     groups = rng.integers(0, population_structure, size=n_samples)
 
     # Spread variant positions across the configured regions.
     total_len = sum(end - start for _, start, end in regions)
-    records: List[dict] = []
     offsets = rng.choice(total_len, size=n_variants, replace=False) if (
         n_variants <= total_len
     ) else rng.integers(0, total_len, size=n_variants)
@@ -105,16 +148,14 @@ def synthetic_cohort(
             else contig
         )
         if reference_blocks_every and vi % reference_blocks_every == 0:
-            records.append(
-                {
-                    "reference_name": reference_name,
-                    "start": pos,
-                    "end": pos + int(rng.integers(1, 200)),
-                    "reference_bases": "N",
-                    "variant_set_id": variant_set_id,
-                    "calls": [],
-                }
-            )
+            yield {
+                "reference_name": reference_name,
+                "start": pos,
+                "end": pos + int(rng.integers(1, 200)),
+                "reference_bases": "N",
+                "variant_set_id": variant_set_id,
+                "calls": [],
+            }
             continue
         ref_base = _BASES[rng.integers(0, 4)]
         alt_base = _BASES[(rng.integers(1, 4) + _BASES.index(ref_base)) % 4]
@@ -143,22 +184,64 @@ def synthetic_cohort(
             for s in sample_range
         ]
         af = float(gts.mean())
-        records.append(
-            {
-                "reference_name": reference_name,
-                "start": pos,
-                "end": pos + 1,
-                "reference_bases": ref_base,
-                "alternate_bases": [alt_base],
-                "info": {"AF": [f"{af:.6f}"]},
-                "variant_set_id": variant_set_id,
-                "calls": calls,
-            }
-        )
+        yield {
+            "reference_name": reference_name,
+            "start": pos,
+            "end": pos + 1,
+            "reference_bases": ref_base,
+            "alternate_bases": [alt_base],
+            "info": {"AF": [f"{af:.6f}"]},
+            "variant_set_id": variant_set_id,
+            "calls": calls,
+        }
 
-    return FixtureSource(
-        variants=records, callsets=callsets, stats=stats
+
+def dump_cohort_stream(
+    root: str,
+    n_samples: int,
+    n_variants: int,
+    variant_set_id: str = DEFAULT_VARIANT_SET_ID,
+    append: bool = False,
+    **kw,
+) -> None:
+    """Write a cohort as a JSONL directory WITHOUT materializing it —
+    the disk-scale twin of ``FixtureSource.dump`` for cohorts too large
+    for memory. ``append=True`` adds another variant set's records and
+    callsets to an existing directory (multi-dataset cohorts).
+    """
+    import json as _json
+    import os as _os
+
+    _os.makedirs(root, exist_ok=True)
+    for name in ("callsets.json.gz", "variants.jsonl.gz"):
+        if _os.path.exists(_os.path.join(root, name)):
+            # Readers treat .gz as authoritative; appending plain files
+            # beside them would be silently invisible.
+            raise ValueError(
+                f"{root} holds gzipped cohort files ({name}); "
+                "dump_cohort_stream writes plain JSONL only"
+            )
+    callsets_path = _os.path.join(root, "callsets.json")
+    rows = []
+    if append and _os.path.exists(callsets_path):
+        with open(callsets_path) as f:
+            rows = _json.load(f)
+    rows.extend(
+        {
+            "id": c.id,
+            "name": c.name,
+            "variant_set_id": c.variant_set_id,
+        }
+        for c in cohort_callsets(n_samples, variant_set_id)
     )
+    with open(callsets_path, "w") as f:
+        _json.dump(rows, f)
+    mode = "a" if append else "w"
+    with open(_os.path.join(root, "variants.jsonl"), mode) as f:
+        for rec in cohort_record_stream(
+            n_samples, n_variants, variant_set_id=variant_set_id, **kw
+        ):
+            f.write(_json.dumps(rec) + "\n")
 
 
 def synthetic_reads(

@@ -886,6 +886,10 @@ CohortCsr* parse_cohort_jsonl(const char* path, const uint8_t* callset_blob,
     forced = threads > 0;  // explicit override skips the size clamp so
                            // tests can exercise the threaded path on
                            // small fixtures
+    if (threads > 64) threads = 64;  // a absurd override must not spawn
+                                     // unbounded threads (a failed
+                                     // std::thread ctor would terminate
+                                     // the embedding interpreter)
   }
   if (threads <= 0) {
     threads = static_cast<int>(std::thread::hardware_concurrency());

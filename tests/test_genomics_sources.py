@@ -307,3 +307,49 @@ class TestGzipCohort:
         jsrc = JsonlSource(str(tmp_path))
         shard = Shard("17", 41196311, 41277499)
         assert len(list(jsrc.stream_variants("", shard))) == 15
+
+
+class TestStreamingCohortDump:
+    def test_stream_dump_equals_in_memory_dump(self, tmp_path):
+        import json
+
+        from spark_examples_tpu.genomics.fixtures import (
+            dump_cohort_stream,
+            synthetic_cohort,
+        )
+
+        synthetic_cohort(6, 40, seed=8).dump(str(tmp_path / "mem"))
+        dump_cohort_stream(str(tmp_path / "stream"), 6, 40, seed=8)
+        for name in ("callsets.json", "variants.jsonl"):
+            a = (tmp_path / "mem" / name).read_text()
+            b = (tmp_path / "stream" / name).read_text()
+            assert a == b, name
+
+    def test_append_builds_joinable_multiset_cohort(self, tmp_path):
+        import numpy as np
+
+        from spark_examples_tpu.genomics.fixtures import dump_cohort_stream
+        from spark_examples_tpu.genomics.sources import JsonlSource
+        from spark_examples_tpu.models.pca import VariantsPcaDriver
+        from spark_examples_tpu.utils.config import PcaConfig
+
+        root = str(tmp_path / "c")
+        dump_cohort_stream(root, 8, 60, variant_set_id="setA", seed=1)
+        dump_cohort_stream(
+            root, 8, 60, variant_set_id="setB", seed=1, append=True
+        )
+        conf = PcaConfig(
+            variant_set_ids=["setA", "setB"],
+            bases_per_partition=20_000,
+            block_variants=32,
+        )
+        result = VariantsPcaDriver(conf, JsonlSource(root)).run()
+        assert len(result) == 16
+        # Identical cohorts under two set ids: twins coincide.
+        by_name = {}
+        for cid, pc1, pc2 in result:
+            by_name.setdefault(cid.split("-", 1)[1], []).append((pc1, pc2))
+        for name, coords in by_name.items():
+            np.testing.assert_allclose(
+                coords[0], coords[1], atol=1e-6, err_msg=name
+            )
