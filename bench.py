@@ -75,10 +75,18 @@ def tpu_time(blocks, cpu_fallback=False):
     import jax.numpy as jnp
 
     # Persistent compilation cache: the N≈2500 eigh compile is minutes the
-    # first time; cached thereafter.
+    # first time; cached thereafter. The dir is keyed by host CPU features
+    # so a cache populated on a different host can't feed this one illegal
+    # instructions (see utils/compile_cache.py).
+    from spark_examples_tpu.utils.compile_cache import compilation_cache_dir
+
     jax.config.update(
         "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+        compilation_cache_dir(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+            )
+        ),
     )
     from spark_examples_tpu.ops import gramian_blockwise, pcoa
 

@@ -18,6 +18,7 @@ from spark_examples_tpu.genomics.types import Variant, has_variation
 
 __all__ = [
     "af_filter",
+    "af_value",
     "carrying_sample_indices",
     "join_datasets",
     "merge_datasets",
@@ -28,20 +29,40 @@ __all__ = [
 ]
 
 
+def af_value(af) -> Optional[float]:
+    """``info["AF"][0]`` as a float, or ``None`` when absent or non-numeric.
+
+    Non-numeric AF (the VCF "." missing marker, or any malformed value)
+    counts as MISSING: under an active filter the record drops, in every
+    tier — staged, fused record stream, and CSR sidecar (which stores it
+    as NaN) — so the tiers stay behavior-identical on bad input. The
+    reference would throw NumberFormatException here
+    (``"AF".toDouble``-style, VariantsPca.scala:100-104); crashing a
+    whole-cohort run on one missing marker is a bug, not parity to keep.
+    """
+    if not af:
+        return None
+    try:
+        return float(af[0])
+    except (TypeError, ValueError):
+        return None
+
+
 def af_filter(
     variants: Iterable[Variant], min_allele_frequency: Optional[float]
 ) -> Iterator[Variant]:
     """Keep variants with ``info["AF"][0] >= threshold``.
 
-    Missing AF drops the variant (``.getOrElse(false)``,
-    VariantsPca.scala:100-104). ``None`` threshold disables the filter.
+    Missing (or non-numeric, see :func:`af_value`) AF drops the variant
+    (``.getOrElse(false)``, VariantsPca.scala:100-104). ``None`` threshold
+    disables the filter.
     """
     if min_allele_frequency is None:
         yield from variants
         return
     for v in variants:
-        af = v.info.get("AF")
-        if af and float(af[0]) >= min_allele_frequency:
+        af = af_value(v.info.get("AF"))
+        if af is not None and af >= min_allele_frequency:
             yield v
 
 

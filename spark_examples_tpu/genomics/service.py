@@ -47,12 +47,16 @@ from spark_examples_tpu.utils.stats import IoStats
 
 __all__ = ["GenomicsServiceServer", "HttpVariantSource"]
 
-# Explicit application-level end-of-stream frame. HTTP chunked truncation
-# is NOT reliably detectable through http.client's line iteration (its
-# read1/peek paths swallow IncompleteRead and report a clean EOF), so the
-# stream is complete only when this sentinel line arrives; anything else
-# is a truncated shard and must error, never feed partial data downstream.
-_END_SENTINEL = b'{"__end__": true}'
+# Explicit application-level framing. HTTP chunked truncation is NOT
+# reliably detectable through http.client's line iteration (its read1/peek
+# paths swallow IncompleteRead and report a clean EOF), so the stream is
+# complete only when the end frame arrives; anything else is a truncated
+# shard and must error, never feed partial data downstream. Every line is
+# type-prefixed ("d " data / "e" end) so NO record payload — whatever
+# bytes a cohort serves — can collide with the end frame: the frame-type
+# channel is out of band with respect to the data bytes.
+_DATA_PREFIX = b"d "
+_END_FRAME = b"e"
 
 
 def _make_handler(source, token: Optional[str]):
@@ -91,7 +95,7 @@ def _make_handler(source, token: Optional[str]):
                         self.send_header("Transfer-Encoding", "chunked")
                         self.end_headers()
                         started = True
-                    payload = line + b"\n"
+                    payload = _DATA_PREFIX + line + b"\n"
                     self.wfile.write(f"{len(payload):x}\r\n".encode())
                     self.wfile.write(payload + b"\r\n")
             except Exception:
@@ -106,7 +110,7 @@ def _make_handler(source, token: Optional[str]):
                 self.send_response(200)
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
-            payload = _END_SENTINEL + b"\n"
+            payload = _END_FRAME + b"\n"
             self.wfile.write(f"{len(payload):x}\r\n".encode())
             self.wfile.write(payload + b"\r\n")
             self.wfile.write(b"0\r\n\r\n")
@@ -290,24 +294,37 @@ class HttpVariantSource:
 
         A stream that ends any other way — connection drop, truncation,
         proxy cutoff — counts as an IO exception and raises; partial
-        shards must never feed the pipeline silently (see _END_SENTINEL).
+        shards must never feed the pipeline silently. Lines are
+        type-prefixed (see _DATA_PREFIX/_END_FRAME) so record bytes can
+        never spoof the end frame; an unprefixed line means a protocol
+        mismatch and raises rather than guessing.
         """
         import http.client
 
         complete = False
+        unframed = False
         try:
             with resp:
                 for line in resp:
-                    line = line.strip()
+                    line = line.rstrip(b"\r\n")
                     if not line:
                         continue
-                    if line == _END_SENTINEL:
+                    if line == _END_FRAME:
                         complete = True
                         break
-                    yield line
+                    if not line.startswith(_DATA_PREFIX):
+                        unframed = True
+                        break
+                    yield line[len(_DATA_PREFIX):]
         except (http.client.HTTPException, OSError) as e:
             self.stats.add(io_exceptions=1)
             raise IOError(f"{path}: stream aborted mid-shard: {e}") from e
+        if unframed:
+            self.stats.add(io_exceptions=1)
+            raise IOError(
+                f"{path}: unframed line on the wire "
+                "(server speaks a different protocol version?)"
+            )
         if not complete:
             self.stats.add(io_exceptions=1)
             raise IOError(

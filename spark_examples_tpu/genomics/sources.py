@@ -201,6 +201,7 @@ def _extracted_records(records, indexes, variant_set_id, stats, min_af):
     unknown callsets. Wrappers shape the output; the semantics live here
     exactly once.
     """
+    from spark_examples_tpu.genomics.datasets import af_value
     from spark_examples_tpu.genomics.types import normalize_contig
 
     for rec in records:
@@ -212,10 +213,11 @@ def _extracted_records(records, indexes, variant_set_id, stats, min_af):
             continue
         stats.add(variants_read=1)
         if min_af is not None:
-            af = (rec.get("info") or {}).get("AF")
+            af = af_value((rec.get("info") or {}).get("AF"))
             # Negated >= (not <) so non-comparable values (NaN) drop
-            # exactly as af_filter's `>= min_af` keep-test does.
-            if not af or not (float(af[0]) >= min_af):
+            # exactly as af_filter's `>= min_af` keep-test does; None is
+            # missing-or-non-numeric (af_value docs).
+            if af is None or not (af >= min_af):
                 continue
         out = []
         for c in rec.get("calls", ()):
@@ -730,6 +732,7 @@ class _CsrCohort:
     def _parse_python(open_fn, callset_ids):
         """Reference parse: json.loads per line -> the same file-ordered
         arrays the native parser produces (parity-tested)."""
+        from spark_examples_tpu.genomics.datasets import af_value
         from spark_examples_tpu.genomics.types import normalize_contig
 
         ord_of = {cid: i for i, cid in enumerate(callset_ids)}
@@ -754,16 +757,11 @@ class _CsrCohort:
                 contig = normalize_contig(rec["reference_name"])
                 if contig is None:
                     continue
-                af = (rec.get("info") or {}).get("AF")
-                # Non-numeric AF (e.g. the VCF "." missing marker) stores
-                # as NaN: with the filter OFF this matches the staged path
-                # (AF untouched); with it ON the record drops where the
-                # staged float() would raise -- strictly more tolerant,
-                # never silently keeps.
-                try:
-                    af_val = float(af[0]) if af else np.nan
-                except (TypeError, ValueError):
-                    af_val = np.nan
+                # Missing/non-numeric AF (af_value's None) stores as NaN:
+                # with the filter OFF AF is untouched, with it ON the
+                # record drops, identically to the staged/fused tiers.
+                af = af_value((rec.get("info") or {}).get("AF"))
+                af_val = np.nan if af is None else af
                 for c in rec.get("calls", ()):
                     if any(g > 0 for g in c.get("genotype", ())):
                         cid = c["callset_id"]

@@ -497,7 +497,7 @@ class VariantsPcaDriver:
 
     # -- stage 5: eigendecomposition ----------------------------------------
 
-    def compute_pca(self, g) -> List[Tuple[str, float, float]]:
+    def compute_pca(self, g, timer=None) -> List[Tuple[str, float, float]]:
         import jax.numpy as jnp
 
         addressable = getattr(g, "is_fully_addressable", True)
@@ -534,8 +534,15 @@ class VariantsPcaDriver:
                         self.mesh, PartitionSpec(None, None)
                     ),
                 )(g)
-            coords, _ = mllib_principal_components_reference(
-                np.asarray(g), self.conf.num_pc
+            from spark_examples_tpu.ops.pcoa import topk_with_gap_check
+
+            gh = np.asarray(g)
+            coords, _ = topk_with_gap_check(
+                lambda kk: mllib_principal_components_reference(gh, kk),
+                self.conf.num_pc,
+                self.index.size,
+                timer=timer,
+                vals_are_squared=True,  # covariance eigenvalues = λ(C)²/(n−1)
             )
         elif self.mesh is not None:
             from spark_examples_tpu.parallel.sharded import sharded_pcoa
@@ -545,10 +552,20 @@ class VariantsPcaDriver:
                 self.conf.num_pc,
                 self.mesh,
                 dense_eigh_limit=self.conf.dense_eigh_limit,
+                timer=timer,
             )
             coords = np.asarray(coords)
         else:
-            coords, _ = pcoa(g, self.conf.num_pc)
+            from spark_examples_tpu.ops.pcoa import topk_with_gap_check
+
+            # k+1 eigenpairs so the default single-host dense path gets
+            # the same flat-spectrum detection as the sharded paths.
+            coords, _ = topk_with_gap_check(
+                lambda kk: pcoa(g, kk),
+                self.conf.num_pc,
+                self.index.size,
+                timer=timer,
+            )
             coords = np.asarray(coords)
         callset_ids = self.index.callset_of_index()
         # The reference emits exactly two components regardless of --num-pc
@@ -630,7 +647,7 @@ class VariantsPcaDriver:
                     calls = self.get_calls(filtered)
                     g = self.get_similarity_matrix(calls)
             with timer.stage("pca"):
-                result = self.compute_pca(g)
+                result = self.compute_pca(g, timer=timer)
             with timer.stage("emit"):
                 self.emit_result(result)
         self.report_io_stats()

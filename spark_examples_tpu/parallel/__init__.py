@@ -18,6 +18,7 @@ Axis conventions:
 
 from spark_examples_tpu.parallel.mesh import make_mesh, DATA_AXIS, MODEL_AXIS
 from spark_examples_tpu.parallel.sharded import (
+    SpectralGapWarning,
     gramian_blockwise_global,
     gramian_variant_parallel,
     gramian_variant_parallel_ring,
@@ -32,6 +33,7 @@ from spark_examples_tpu.parallel.distributed import (
 )
 
 __all__ = [
+    "SpectralGapWarning",
     "make_mesh",
     "DATA_AXIS",
     "MODEL_AXIS",

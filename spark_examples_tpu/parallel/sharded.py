@@ -30,10 +30,15 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_examples_tpu.ops.centering import double_center
-from spark_examples_tpu.ops.pcoa import normalize_eigvec_signs
+from spark_examples_tpu.ops.pcoa import (
+    SpectralGapWarning,
+    check_spectral_gap,
+    normalize_eigvec_signs,
+)
 from spark_examples_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 __all__ = [
+    "SpectralGapWarning",
     "gramian_blockwise_global",
     "gramian_variant_parallel",
     "gramian_variant_parallel_ring",
@@ -337,6 +342,8 @@ def topk_eig_randomized(
     iters: int = 30,
     seed: int = 0,
     mesh: Mesh = None,
+    timer=None,
+    gap_warn_ratio: float = 0.95,
 ):
     """Top-|λ| eigenpairs of symmetric C by randomized subspace iteration.
 
@@ -356,6 +363,15 @@ def topk_eig_randomized(
     ~2e-7 max coordinate error vs dense ``eigh`` within 10 iterations at
     N=2048 (measured; see tests). The 30-iteration default is headroom for
     flatter spectra; only near-degenerate λ₁≈λ₂ pairs need more.
+
+    Degeneracy is detected, not silent: when |λ_{k+1}|/|λ_k| exceeds
+    ``gap_warn_ratio`` the returned subspace is well-converged but any
+    basis *within* a near-degenerate pair is rotation-ambiguous — for a
+    dense ``eigh`` just as much as for this method (a weakly structured
+    cohort has no well-defined PC2). A :class:`SpectralGapWarning` fires
+    with the ratio, and the ratio lands in the stage-timer report when a
+    ``timer`` is passed. The Ritz values needed for the check come free
+    from the oversampled panel.
     """
     n = c.shape[0]
     p = min(n, k + oversample)
@@ -395,10 +411,13 @@ def topk_eig_randomized(
         vals = jax.jit(
             lambda a: a, out_shardings=NamedSharding(mesh, P(None))
         )(vals)
+    check_spectral_gap(np.asarray(vals), k, gap_warn_ratio, timer)
     return normalize_eigvec_signs(vecs[:, :k]), vals[:k]
 
 
-def sharded_pcoa(g, k: int, mesh: Mesh, dense_eigh_limit: int = 8192):
+def sharded_pcoa(
+    g, k: int, mesh: Mesh, dense_eigh_limit: int = 8192, timer=None
+):
     """Center + top-k eigenvectors of a (possibly mesh-sharded) Gramian.
 
     Small N: gather the centered matrix and run dense ``eigh`` (exact, the
@@ -417,7 +436,15 @@ def sharded_pcoa(g, k: int, mesh: Mesh, dense_eigh_limit: int = 8192):
                 lambda a: a, out_shardings=NamedSharding(mesh, P(None, None))
             )(c)
         c = jax.device_put(np.asarray(c))
-        from spark_examples_tpu.ops.pcoa import principal_components
+        from spark_examples_tpu.ops.pcoa import (
+            principal_components,
+            topk_with_gap_check,
+        )
 
-        return principal_components(c, k)
-    return topk_eig_randomized(c, k, mesh=mesh)
+        # One extra eigenpair so the gap past k is checkable — dense eigh
+        # is exactly as rotation-ambiguous on a flat spectrum as the
+        # randomized path, so it gets the same loud degeneracy detection.
+        return topk_with_gap_check(
+            lambda kk: principal_components(c, kk), k, n, timer=timer
+        )
+    return topk_eig_randomized(c, k, mesh=mesh, timer=timer)

@@ -1,0 +1,48 @@
+"""Host-feature-keyed XLA persistent compilation cache directory.
+
+The repo-local ``.jax_cache/`` persists across rounds, but XLA's cache key
+does not cover host CPU features: a cache entry compiled on a host with
+AVX-512 can be loaded on a host without it and jump into illegal
+instructions (XLA warns "could lead to ... SIGILL" on feature mismatch —
+observed in the round-2 bench tail after the workdir migrated hosts).
+
+The guard is structural rather than reactive: the cache directory name
+embeds a digest of this host's CPU feature set (plus the machine
+architecture), so a different host simply gets a different — initially
+empty — cache directory instead of one full of incompatible binaries.
+Stale sibling directories from other hosts are left in place (another
+round on the original host can still reuse them); ``.jax_cache/`` is
+gitignored either way.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import platform
+
+__all__ = ["host_feature_key", "compilation_cache_dir"]
+
+
+def host_feature_key() -> str:
+    """Digest of the CPU feature flags the local XLA backend compiles for."""
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    # One processor's flag set suffices; sort for stability
+                    # across kernels that order flags differently.
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass  # non-Linux: fall back to coarse platform identity below
+    ident = f"{platform.machine()}|{feats}"
+    return hashlib.sha256(ident.encode()).hexdigest()[:12]
+
+
+def compilation_cache_dir(base: str) -> str:
+    """Per-host-feature-set subdirectory of ``base`` (created if missing)."""
+    path = os.path.join(base, f"host-{host_feature_key()}")
+    os.makedirs(path, exist_ok=True)
+    return path

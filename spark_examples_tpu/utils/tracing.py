@@ -17,17 +17,37 @@ __all__ = ["StageTimer", "profiler_trace"]
 
 
 class StageTimer:
-    """Accumulates wall-clock per named stage; prints a report block."""
+    """Accumulates wall-clock per named stage; prints a report block.
+
+    Stages may also attach short diagnostic notes (e.g. the spectral gap
+    ratio from the randomized eig) which print alongside the timings —
+    the report is the one artifact every run shows the user.
+    """
 
     def __init__(self) -> None:
         self.seconds: Dict[str, float] = {}
+        self.notes: Dict[str, list] = {}
+        self._active: list = []
+
+    def note(self, text: str) -> None:
+        """Attach a note to the currently-running stage.
+
+        Library code deep under a stage (e.g. the eig kernels) need not
+        know what the driver named its stages; a note issued outside any
+        stage files under "" and still prints, so diagnostics can never
+        vanish by landing on an unknown key.
+        """
+        key = self._active[-1] if self._active else ""
+        self.notes.setdefault(key, []).append(text)
 
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
         t0 = time.perf_counter()
+        self._active.append(name)
         try:
             yield
         finally:
+            self._active.pop()
             self.seconds[name] = (
                 self.seconds.get(name, 0.0) + time.perf_counter() - t0
             )
@@ -38,6 +58,8 @@ class StageTimer:
         for name, secs in self.seconds.items():
             pct = 100.0 * secs / total if total else 0.0
             lines.append(f"{name}: {secs:.3f}s ({pct:.1f}%)")
+            lines.extend(f"  {n}" for n in self.notes.get(name, ()))
+        lines.extend(f"{n}" for n in self.notes.get("", ()))
         lines.append(f"total: {total:.3f}s")
         return "\n".join(lines)
 

@@ -87,6 +87,47 @@ def test_jsonl_source_parity(tmp_path, min_af):
     assert fast_src.stats.variants_read == slow_src.stats.variants_read
 
 
+@pytest.mark.parametrize("min_af", [None, 0.2])
+def test_nonnumeric_af_behavior_identical_across_tiers(tmp_path, min_af):
+    """A VCF "."-style AF must get the SAME treatment from the staged
+    path, the fused record stream, and the CSR sidecar: missing → dropped
+    under the filter, untouched without it (round-2 ADVICE: the sidecar
+    dropped where the staged float() raised)."""
+    import json
+
+    cohort = _cohort()
+    cohort.dump(str(tmp_path / "c"))
+    cid = cohort.list_callsets(DEFAULT_VARIANT_SET_ID)[0].id
+    bad = {
+        "reference_name": "17",
+        "start": 41_200_000,
+        "end": 41_200_001,
+        "reference_bases": "A",
+        "variant_set_id": DEFAULT_VARIANT_SET_ID,
+        "info": {"AF": ["."]},
+        "calls": [{"callset_id": cid, "genotype": [1]}],
+    }
+    with open(tmp_path / "c" / "variants.jsonl", "a") as fh:
+        fh.write(json.dumps(bad) + "\n")
+
+    shards = shards_for_references(REFS, 20_000)
+    slow_src = JsonlSource(str(tmp_path / "c"))
+    fast_src = JsonlSource(str(tmp_path / "c"))
+    index = CallsetIndex.from_source(slow_src, [DEFAULT_VARIANT_SET_ID])
+    slow = _slow(
+        slow_src, DEFAULT_VARIANT_SET_ID, shards, index.indexes, min_af
+    )
+    fast = _fast(
+        fast_src, DEFAULT_VARIANT_SET_ID, shards, index.indexes, min_af
+    )
+    assert fast == slow
+    # The record itself is served with the filter off, dropped with it on.
+    clean = _slow(
+        _cohort(), DEFAULT_VARIANT_SET_ID, shards, index.indexes, min_af
+    )
+    assert len(slow) == len(clean) + (0 if min_af else 1)
+
+
 def test_http_source_parity():
     from spark_examples_tpu.genomics.service import (
         GenomicsServiceServer,

@@ -1,5 +1,7 @@
 """Smoke test that the virtual 8-device CPU mesh is actually wired up."""
 
+import os
+
 import jax
 
 
@@ -105,3 +107,20 @@ def test_graft_entry_contract():
     assert out.shape == (128, 2)
     for n in (1, 3, 8):
         ge.dryrun_multichip(n)
+
+
+def test_compile_cache_dir_is_host_keyed(tmp_path):
+    """The persistent XLA cache dir must embed the host CPU feature set so
+    a cache populated on a different host can never feed this one illegal
+    instructions (round-2 bench tail SIGILL-risk warning)."""
+    from spark_examples_tpu.utils.compile_cache import (
+        compilation_cache_dir,
+        host_feature_key,
+    )
+
+    key = host_feature_key()
+    assert len(key) == 12
+    assert key == host_feature_key()  # stable within a host
+    path = compilation_cache_dir(str(tmp_path))
+    assert os.path.isdir(path)
+    assert os.path.basename(path) == f"host-{key}"

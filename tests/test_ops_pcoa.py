@@ -139,3 +139,36 @@ def test_pcoa_scaled_coordinates_recover_distances():
     coords, w = pcoa(g, 3, scale=True)
     coords = np.asarray(coords, dtype=np.float64)
     np.testing.assert_allclose(coords @ coords.T, g, atol=1e-3)
+
+
+def test_gap_check_unsquares_covariance_eigenvalues():
+    """The --precise path feeds MLlib-literal COVARIANCE eigenvalues
+    (λ(C)²/(n−1)): a C-scale gap ratio of 0.96 is 0.9216 squared, which
+    would sail under the 0.95 threshold without the sqrt."""
+    import pytest
+
+    from spark_examples_tpu.ops.pcoa import (
+        SpectralGapWarning,
+        topk_with_gap_check,
+    )
+
+    coords = np.zeros((2, 2))
+    sq_vals = np.array([25.0, 23.04])  # λ = 5, 4.8 → true ratio 0.96
+
+    with pytest.warns(SpectralGapWarning):
+        topk_with_gap_check(
+            lambda kk: (coords[:, :kk], sq_vals[:kk]),
+            1,
+            2,
+            vals_are_squared=True,
+        )
+
+    # Un-sqrt'd, the same values stay (wrongly) silent — the scale gap
+    # this test pins.
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", SpectralGapWarning)
+        topk_with_gap_check(
+            lambda kk: (coords[:, :kk], sq_vals[:kk]), 1, 2
+        )

@@ -204,6 +204,78 @@ def test_cli_pca_with_mesh_flag(capsys, tmp_path):
     assert (tmp_path / "mesh-pca.tsv").exists()
 
 
+class TestSpectralGapWarning:
+    """Flat spectra must be loud, not silently unstable (round-2 verdict:
+    a weakly structured cohort gets a rotation-ambiguous PC2 from dense
+    eigh and randomized eig alike — detect it at runtime)."""
+
+    @staticmethod
+    def _matrix_with_spectrum(w, seed=3):
+        rng = np.random.default_rng(seed)
+        q, _ = np.linalg.qr(rng.random((len(w), len(w))))
+        return ((q * w) @ q.T).astype(np.float32)
+
+    def test_degenerate_gap_warns(self):
+        from spark_examples_tpu.parallel import SpectralGapWarning
+
+        c = self._matrix_with_spectrum(
+            np.array([10.0, 5.0, 4.999] + [0.01] * 29)
+        )
+        with pytest.warns(SpectralGapWarning, match=r"\|λ3\|/\|λ2\|"):
+            topk_eig_randomized(jnp.asarray(c), 2, iters=40)
+
+    def test_separated_gap_silent(self):
+        import warnings
+
+        from spark_examples_tpu.parallel import SpectralGapWarning
+
+        c = self._matrix_with_spectrum(
+            np.array([10.0, 5.0, 1.0] + [0.01] * 29)
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", SpectralGapWarning)
+            topk_eig_randomized(jnp.asarray(c), 2, iters=40)
+
+    def test_gap_ratio_lands_in_stage_report(self):
+        from spark_examples_tpu.utils.tracing import StageTimer
+
+        timer = StageTimer()
+        c = self._matrix_with_spectrum(
+            np.array([10.0, 5.0, 1.0] + [0.01] * 29)
+        )
+        with timer.stage("pca"):
+            topk_eig_randomized(jnp.asarray(c), 2, iters=40, timer=timer)
+        report = timer.report()
+        assert "spectral gap" in report
+        assert "0.2" in timer.notes["pca"][0]  # |λ3|/|λ2| = 1/5
+
+    def test_dense_paths_also_detect_degeneracy(self):
+        """The dense-eigh branches (sharded_pcoa small-N, the default
+        single-host pcoa) must be as loud on a flat spectrum as the
+        randomized path — review finding round 3."""
+        from spark_examples_tpu.ops.pcoa import check_spectral_gap
+        from spark_examples_tpu.parallel import SpectralGapWarning
+
+        # Build the near-degenerate pair INSIDE the centering-invariant
+        # subspace (eigvecs ⊥ 1), so double_center leaves the flat gap
+        # intact on the way into the dense branch.
+        rng = np.random.default_rng(3)
+        a = rng.random((32, 32))
+        a -= a.mean(axis=0, keepdims=True)  # columns ⊥ ones
+        q, _ = np.linalg.qr(a)
+        w = np.array([10.0, 5.0, 4.999] + [0.01] * 28)
+        c = ((q[:, :31] * w) @ q[:, :31].T).astype(np.float32)
+
+        mesh = make_mesh("data:4,model:2")
+        g = jax.device_put(c, NamedSharding(mesh, P("data", "model")))
+        with pytest.warns(SpectralGapWarning):
+            sharded_pcoa(g, 2, mesh)  # n=32 <= limit: dense branch
+
+        vecs, vals = principal_components(jnp.asarray(c), 3)
+        with pytest.warns(SpectralGapWarning):
+            check_spectral_gap(np.asarray(vals), 2)
+
+
 def test_ring_reduction_matches_psum():
     from spark_examples_tpu.parallel import gramian_variant_parallel_ring
 

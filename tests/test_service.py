@@ -75,6 +75,40 @@ class TestStreamParity:
         )
 
 
+class TestFraming:
+    def test_sentinel_shaped_record_cannot_spoof_end_frame(self):
+        """Framing is type-prefixed: a served record whose bytes match any
+        end-of-stream marker must round-trip as data, never terminate the
+        stream early (round-2 ADVICE: the old framing was in-band)."""
+        inner = synthetic_cohort(4, 10, seed=1)
+
+        class ServesHostileRecords:
+            def list_callsets(self, vsid):
+                return inner.list_callsets(vsid)
+
+            def stream_variants(self, vsid, shard):
+                # Raw dicts pass through the server unwrapped; these are
+                # the closest on-the-wire shapes to the framing tokens.
+                yield {"__end__": True}
+                yield from inner.stream_variants(vsid, shard)
+
+            def stream_reads(self, rgsid, shard):
+                return inner.stream_reads(rgsid, shard)
+
+        server = GenomicsServiceServer(ServesHostileRecords()).start()
+        try:
+            http = HttpVariantSource(f"http://127.0.0.1:{server.port}")
+            shard = shards_for_references(REFS, 100_000)[0]
+            # At the wire layer all 11 records arrive — the sentinel-shaped
+            # one as plain data, then the real variants; nothing truncates.
+            recs = list(http._wire_variant_records("", shard))
+            assert recs[0] == {"__end__": True}
+            assert len(recs) == 11
+            assert http.stats.io_exceptions == 0
+        finally:
+            server.stop()
+
+
 class TestAuth:
     def test_token_required(self):
         src = synthetic_cohort(4, 10, seed=1)
