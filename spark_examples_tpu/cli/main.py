@@ -53,7 +53,9 @@ def _network_source(args):
     from spark_examples_tpu.genomics.service import HttpVariantSource
 
     return HttpVariantSource(
-        args.api_url, credentials=get_access_token(args.client_secrets)
+        args.api_url,
+        credentials=get_access_token(args.client_secrets),
+        cache_dir=getattr(args, "cache_dir", None),
     )
 
 

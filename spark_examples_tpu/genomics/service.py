@@ -26,6 +26,7 @@ record-for-record identical to reading it locally with ``JsonlSource``.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import urllib.error
 import urllib.request
@@ -59,6 +60,41 @@ _DATA_PREFIX = b"d "
 _END_FRAME = b"e"
 
 
+def _http_code(exc: IOError) -> Optional[int]:
+    """HTTP status behind an IOError raised by ``_request`` (None when the
+    failure was transport-level, not a served response)."""
+    cause = exc.__cause__
+    return getattr(cause, "code", None)
+
+
+def _decoded_lines(resp) -> Iterator[bytes]:
+    """Response lines, transparently gunzipping Content-Encoding: gzip.
+
+    Incremental: one decompressobj across the stream, lines split as
+    bytes arrive — the stream never materializes. A truncated gzip
+    stream simply yields fewer lines; the framing layer above detects
+    the missing end frame and raises.
+    """
+    if resp.headers.get("Content-Encoding") != "gzip":
+        yield from resp
+        return
+    import zlib
+
+    d = zlib.decompressobj(31)
+    buf = b""
+    while True:
+        chunk = resp.read(65536)
+        if not chunk:
+            break
+        buf += d.decompress(chunk)
+        parts = buf.split(b"\n")
+        buf = parts.pop()
+        yield from parts
+    buf += d.flush()
+    if buf:
+        yield buf
+
+
 def _make_handler(source, token: Optional[str]):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -86,33 +122,58 @@ def _make_handler(source, token: Optional[str]):
             # Chunked transfer: record count is unknown up front (the
             # server-streaming shape of VariantStreamIterator). Headers go
             # out lazily so a source that fails BEFORE yielding anything
-            # still gets a clean 500 from do_GET.
+            # still gets a clean 500 from do_GET. When the client accepts
+            # gzip, the framed lines ride one gzip member across the whole
+            # stream — JSONL compresses ~10×, the closest HTTP analog to
+            # the reference's binary protobuf-over-gRPC efficiency
+            # (VariantsRDD.scala:26,210-211). A mid-stream kill drops the
+            # connection unflushed, so the end frame can never be
+            # decompressed from a truncated stream.
+            import zlib
+
+            comp = (
+                zlib.compressobj(6, zlib.DEFLATED, 31)
+                if "gzip" in self.headers.get("Accept-Encoding", "")
+                else None
+            )
             started = False
+
+            def start_headers():
+                self.send_response(200)
+                self.send_header("Transfer-Encoding", "chunked")
+                if comp is not None:
+                    self.send_header("Content-Encoding", "gzip")
+                self.end_headers()
+
+            def send_chunk(data: bytes):
+                if data:
+                    self.wfile.write(f"{len(data):x}\r\n".encode())
+                    self.wfile.write(data + b"\r\n")
+
             try:
                 for line in lines:
                     if not started:
-                        self.send_response(200)
-                        self.send_header("Transfer-Encoding", "chunked")
-                        self.end_headers()
+                        start_headers()
                         started = True
                     payload = _DATA_PREFIX + line + b"\n"
-                    self.wfile.write(f"{len(payload):x}\r\n".encode())
-                    self.wfile.write(payload + b"\r\n")
+                    send_chunk(
+                        comp.compress(payload) if comp else payload
+                    )
             except Exception:
                 if not started:
                     raise
                 # Mid-stream source failure with a 200 already on the
-                # wire: drop the connection without the end sentinel — the
-                # client treats a sentinel-less stream as truncated.
+                # wire: drop the connection without the end frame — the
+                # client treats a frameless stream as truncated.
                 self.close_connection = True
                 return
             if not started:
-                self.send_response(200)
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
+                start_headers()
             payload = _END_FRAME + b"\n"
-            self.wfile.write(f"{len(payload):x}\r\n".encode())
-            self.wfile.write(payload + b"\r\n")
+            if comp is not None:
+                send_chunk(comp.compress(payload) + comp.flush())
+            else:
+                send_chunk(payload)
             self.wfile.write(b"0\r\n\r\n")
 
         def do_GET(self):  # noqa: N802 — http.server API
@@ -164,6 +225,37 @@ def _make_handler(source, token: Optional[str]):
                             q.get("read_group_set_id", ""), shard
                         )
                     )
+                elif url.path == "/identity":
+                    # Cohort content digest (the ETag analog): clients key
+                    # mirrored-cohort caches by it. 404 when the source
+                    # cannot identify itself — caching is then impossible
+                    # and clients stream directly.
+                    ident = getattr(source, "cohort_identity", None)
+                    ident = ident() if ident else None
+                    if ident is None:
+                        self.send_error(404, "source has no identity")
+                        return
+                    body = (json.dumps({"identity": ident}) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif url.path.startswith("/export/"):
+                    # Whole-cohort interchange-file export, framed and
+                    # gzip-able like every stream: the bulk path remote
+                    # mirrors are built from.
+                    name = url.path[len("/export/"):]
+                    export = getattr(source, "export_lines", None)
+                    if export is None:
+                        self.send_error(404, "source does not export")
+                        return
+                    try:
+                        lines = export(name)
+                        self._send_lines(iter(lines))
+                    except KeyError:
+                        self.send_error(404, f"no such export: {name}")
+                    except FileNotFoundError:
+                        self.send_error(404, f"export missing: {name}")
                 else:
                     self.send_error(404)
             except (KeyError, ValueError) as e:
@@ -218,6 +310,20 @@ class HttpVariantSource:
     source (contig drop + STRICT semantics are server-side, mirroring the
     enforceShardBoundary server contract; the builder re-applies the
     contig rule defensively).
+
+    Two wire-efficiency tiers close the gap to the reference's binary
+    gRPC streaming (``VariantsRDD.scala:26,210-211``):
+
+    - streams are gzip-encoded end to end when the server supports it
+      (~10× fewer bytes for JSONL; on by default, transparent);
+    - with ``cache_dir`` set, the WHOLE cohort is mirrored locally once —
+      keyed by the server's ``/identity`` content digest (the ETag
+      analog) — and every subsequent call is served by a local
+      :class:`JsonlSource` over the mirror, which brings the CSR-sidecar
+      warm tier (~100× over re-parse, zero network) to remote cohorts.
+      A changed server cohort changes the identity and triggers a fresh
+      mirror; a server without ``/identity`` silently degrades to direct
+      streaming.
     """
 
     def __init__(
@@ -226,15 +332,19 @@ class HttpVariantSource:
         credentials: Optional[Credentials] = None,
         stats: Optional[IoStats] = None,
         timeout: float = 60.0,
+        cache_dir: Optional[str] = None,
     ):
         self.base_url = base_url.rstrip("/")
         self._token = credentials.token if credentials else ""
         self.stats = stats if stats is not None else IoStats()
         self._timeout = timeout
+        self._cache_dir = cache_dir
+        self._mirror = None  # resolved lazily: JsonlSource | False | None
 
     def _request(self, path: str, params: dict):
         url = f"{self.base_url}{path}?{urlencode(params)}"
         req = urllib.request.Request(url)
+        req.add_header("Accept-Encoding", "gzip")
         if self._token:
             req.add_header("Authorization", f"Bearer {self._token}")
         self.stats.add(requests=1)
@@ -250,7 +360,95 @@ class HttpVariantSource:
             self.stats.add(io_exceptions=1)
             raise IOError(f"{path}: {e.reason}") from e
 
+    # -- cohort mirror cache ------------------------------------------------
+
+    def _resolve_mirror(self):
+        """JsonlSource over the local mirror, downloading it first if this
+        identity has never been mirrored; False = caching unavailable
+        (no cache_dir, or server without /identity)."""
+        if self._mirror is not None:
+            return self._mirror
+        if not self._cache_dir:
+            self._mirror = False
+            return False
+        try:
+            with self._request("/identity", {}) as resp:
+                ident = json.load(resp)["identity"]
+        except IOError as e:
+            # ONLY a served 404 (older server / unidentifiable source)
+            # degrades to direct streaming; transport trouble or auth
+            # failure must surface here, not silently disable the cache
+            # for a multi-thousand-shard run.
+            if _http_code(e) == 404:
+                self._mirror = False
+                return False
+            raise
+        root = os.path.join(self._cache_dir, f"cohort-{ident}")
+        if not os.path.exists(os.path.join(root, ".complete")):
+            self._download_mirror(root)
+        from spark_examples_tpu.genomics.sources import JsonlSource
+
+        self._mirror = JsonlSource(root, stats=self.stats)
+        return self._mirror
+
+    def _download_mirror(self, root: str) -> None:
+        """Atomically populate ``root`` with the served cohort's
+        interchange files: download into a temp dir, mark complete,
+        rename. A crash mid-download leaves only a temp dir that can
+        never be mistaken for a mirror; a populate race is resolved by
+        whichever process renames first (identical content by identity)."""
+        import shutil
+        import tempfile
+
+        os.makedirs(self._cache_dir, exist_ok=True)
+        tmp = tempfile.mkdtemp(dir=self._cache_dir, prefix=".mirror-")
+        try:
+            for name in ("callsets.json", "variants.jsonl", "reads.jsonl"):
+                try:
+                    resp = self._request(f"/export/{name}", {})
+                except IOError as e:
+                    if name == "reads.jsonl" and _http_code(e) == 404:
+                        continue  # reads are optional in the layout
+                    raise
+                with open(os.path.join(tmp, name), "wb") as out:
+                    for line in self._stream_lines(
+                        resp, f"/export/{name}"
+                    ):
+                        out.write(line)
+                        out.write(b"\n")
+            open(os.path.join(tmp, ".complete"), "w").close()
+            try:
+                os.rename(tmp, root)
+            except OSError:
+                # Lost a populate race: the winner's mirror is identical
+                # by identity — never touch an existing complete root
+                # (another process may be reading it right now).
+                if not os.path.exists(os.path.join(root, ".complete")):
+                    raise
+                shutil.rmtree(tmp, ignore_errors=True)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        # Identity keys on (size, mtime): a regenerated-but-identical
+        # server file still mints a new identity, so prune the now-stale
+        # sibling mirrors or cache_dir grows without bound. Only after a
+        # SUCCESSFUL download — the cold path already moved the whole
+        # cohort, a stale reader losing its files mid-run is the rare
+        # case pruning-on-warm would make common.
+        base = os.path.basename(root)
+        for entry in os.listdir(self._cache_dir):
+            if entry.startswith("cohort-") and entry != base:
+                shutil.rmtree(
+                    os.path.join(self._cache_dir, entry),
+                    ignore_errors=True,
+                )
+
+    # -- source protocol ----------------------------------------------------
+
     def list_callsets(self, variant_set_id: str) -> List[Callset]:
+        mirror = self._resolve_mirror()
+        if mirror:
+            return mirror.list_callsets(variant_set_id)
         with self._request(
             "/callsets", {"variant_set_id": variant_set_id}
         ) as resp:
@@ -282,6 +480,10 @@ class HttpVariantSource:
     def stream_variants(
         self, variant_set_id: str, shard: Shard
     ) -> Iterator[Variant]:
+        mirror = self._resolve_mirror()
+        if mirror:
+            yield from mirror.stream_variants(variant_set_id, shard)
+            return
         for rec in self._wire_variant_records(variant_set_id, shard):
             v = variant_from_record(rec)
             if v is None:
@@ -300,12 +502,13 @@ class HttpVariantSource:
         mismatch and raises rather than guessing.
         """
         import http.client
+        import zlib
 
         complete = False
         unframed = False
         try:
             with resp:
-                for line in resp:
+                for line in _decoded_lines(resp):
                     line = line.rstrip(b"\r\n")
                     if not line:
                         continue
@@ -316,7 +519,7 @@ class HttpVariantSource:
                         unframed = True
                         break
                     yield line[len(_DATA_PREFIX):]
-        except (http.client.HTTPException, OSError) as e:
+        except (http.client.HTTPException, OSError, zlib.error) as e:
             self.stats.add(io_exceptions=1)
             raise IOError(f"{path}: stream aborted mid-shard: {e}") from e
         if unframed:
@@ -341,6 +544,12 @@ class HttpVariantSource:
         """Fused fast path over the wire records (see
         sources._carrying_records); the server already applied STRICT
         slicing, contig normalization, and the variant-set filter."""
+        mirror = self._resolve_mirror()
+        if mirror:
+            yield from mirror.stream_carrying(
+                variant_set_id, shard, indexes, min_allele_frequency
+            )
+            return
         from spark_examples_tpu.genomics.sources import _carrying_records
 
         yield from _carrying_records(
@@ -360,6 +569,12 @@ class HttpVariantSource:
     ):
         """Fused multi-dataset fast path over the wire records (see
         sources._carrying_keyed_records)."""
+        mirror = self._resolve_mirror()
+        if mirror:
+            yield from mirror.stream_carrying_keyed(
+                variant_set_id, shard, indexes, min_allele_frequency
+            )
+            return
         from spark_examples_tpu.genomics.sources import (
             _carrying_keyed_records,
         )
@@ -375,6 +590,10 @@ class HttpVariantSource:
     def stream_reads(
         self, read_group_set_id: str, shard: Shard
     ) -> Iterator[Read]:
+        mirror = self._resolve_mirror()
+        if mirror:
+            yield from mirror.stream_reads(read_group_set_id, shard)
+            return
         self.stats.add(partitions=1, reference_bases=shard.range)
         resp = self._request(
             "/reads",

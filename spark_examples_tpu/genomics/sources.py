@@ -394,6 +394,7 @@ class FixtureSource:
         self._fail_once = set(fail_shards)
         self._variant_idx: Optional[_SortedIndex] = None
         self._read_idx: Optional[_SortedIndex] = None
+        self._identity: Optional[str] = None
 
     @staticmethod
     def _variant_key(item):
@@ -530,9 +531,61 @@ class FixtureSource:
         """Attach read records so one cohort serves both pipelines."""
         self._reads = list(reads)
         self._read_idx = None
+        self._identity = None
 
     def reads_records(self) -> list:
         return list(self._reads)
+
+    def cohort_identity(self) -> str:
+        """Content digest identifying this cohort for remote caching.
+
+        Serving clients cache mirrored cohorts keyed by this value (the
+        ETag analog); any change to the records changes the identity, so
+        a stale client mirror can never be mistaken for current data.
+        Computed once — a served fixture's records don't change (the only
+        in-place mutator, :meth:`add_reads`, invalidates the cache) —
+        so warm-mirror clients probing /identity cost O(1), not a
+        re-serialization of the whole cohort per probe.
+        """
+        if self._identity is None:
+            import hashlib
+
+            h = hashlib.sha256()
+            for name in ("callsets.json", "variants.jsonl", "reads.jsonl"):
+                for line in self.export_lines(name):
+                    h.update(line)
+                    h.update(b"\n")
+                h.update(b"\x00")
+            self._identity = h.hexdigest()[:16]
+        return self._identity
+
+    def export_lines(self, name: str) -> Iterator[bytes]:
+        """Serialized interchange-file lines for the whole-cohort export
+        endpoint (the schema of :meth:`dump`, streamed instead of
+        written)."""
+        if name == "callsets.json":
+            yield json.dumps(
+                [
+                    {
+                        "id": c.id,
+                        "name": c.name,
+                        "variant_set_id": c.variant_set_id,
+                    }
+                    for c in self._callsets
+                ]
+            ).encode()
+        elif name == "variants.jsonl":
+            for rec in self._variants:
+                if isinstance(rec, Variant):
+                    rec = _variant_to_record(rec)
+                yield json.dumps(rec).encode()
+        elif name == "reads.jsonl":
+            for rec in self._reads:
+                if isinstance(rec, Read):
+                    rec = _read_to_record(rec)
+                yield json.dumps(rec).encode()
+        else:
+            raise KeyError(name)
 
     def dump(self, root: str) -> None:
         """Write the cohort as a JSONL directory readable by JsonlSource.
@@ -1056,6 +1109,45 @@ class JsonlSource:
         if os.path.exists(path + ".gz"):
             return gzip.open(path + ".gz", "rt")
         return open(path, "rt")
+
+    def cohort_identity(self) -> Optional[str]:
+        """Cheap cohort digest for remote caching: (name, size, mtime_ns)
+        of every interchange file — the same invalidation convention the
+        CSR sidecar uses, so "file changed" means the same thing to the
+        local warm tier and to remote mirrors."""
+        import hashlib
+
+        h = hashlib.sha256()
+        found = False
+        for name in ("callsets.json", "variants.jsonl", "reads.jsonl"):
+            for path in (
+                os.path.join(self.root, name),
+                os.path.join(self.root, name + ".gz"),
+            ):
+                if os.path.exists(path):
+                    st = os.stat(path)
+                    h.update(
+                        f"{os.path.basename(path)}|{st.st_size}"
+                        f"|{st.st_mtime_ns}\n".encode()
+                    )
+                    found = True
+        return h.hexdigest()[:16] if found else None
+
+    def export_lines(self, name: str) -> Iterator[bytes]:
+        """Raw interchange-file lines (no parse — the export endpoint is
+        a passthrough for file-backed cohorts)."""
+        if name not in ("callsets.json", "variants.jsonl", "reads.jsonl"):
+            raise KeyError(name)
+        path = os.path.join(self.root, name)
+        if not (os.path.exists(path) or os.path.exists(path + ".gz")):
+            if name == "reads.jsonl":
+                return  # reads are optional in the interchange layout
+            raise FileNotFoundError(path)
+        with self._open(name) as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if line:
+                    yield line.encode()
 
     def _variants_index(self) -> _SortedIndex:
         if self._variant_index is None:

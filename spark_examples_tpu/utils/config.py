@@ -104,6 +104,13 @@ def add_genomics_flags(p: argparse.ArgumentParser) -> None:
         "from (see the serve-cohort subcommand)",
     )
     p.add_argument(
+        "--cache-dir",
+        default=None,
+        help="Local directory for mirrored remote cohorts (keyed by the "
+        "server's /identity digest): repeat runs against the same served "
+        "cohort skip the network and hit the warm sidecar tier",
+    )
+    p.add_argument(
         "--input-path",
         default=None,
         help="Path to a cohort snapshot or JSONL cohort directory "
