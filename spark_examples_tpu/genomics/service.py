@@ -341,10 +341,15 @@ class HttpVariantSource:
         self._cache_dir = cache_dir
         self._mirror = None  # resolved lazily: JsonlSource | False | None
 
-    def _request(self, path: str, params: dict):
+    def _request(self, path: str, params: dict, stream: bool = False):
         url = f"{self.base_url}{path}?{urlencode(params)}"
         req = urllib.request.Request(url)
-        req.add_header("Accept-Encoding", "gzip")
+        if stream:
+            # Only the framed stream endpoints decode gzip
+            # (_decoded_lines); advertising it on plain-JSON paths would
+            # invite a gzip-capable intermediary to encode bodies that
+            # json.load reads raw.
+            req.add_header("Accept-Encoding", "gzip")
         if self._token:
             req.add_header("Authorization", f"Bearer {self._token}")
         self.stats.add(requests=1)
@@ -405,7 +410,9 @@ class HttpVariantSource:
         try:
             for name in ("callsets.json", "variants.jsonl", "reads.jsonl"):
                 try:
-                    resp = self._request(f"/export/{name}", {})
+                    resp = self._request(
+                        f"/export/{name}", {}, stream=True
+                    )
                 except IOError as e:
                     if name == "reads.jsonl" and _http_code(e) == 404:
                         continue  # reads are optional in the layout
@@ -471,6 +478,7 @@ class HttpVariantSource:
                 "start": shard.start,
                 "end": shard.end,
             },
+            stream=True,
         )
         return (
             json.loads(line)
@@ -603,6 +611,7 @@ class HttpVariantSource:
                 "start": shard.start,
                 "end": shard.end,
             },
+            stream=True,
         )
         for line in self._stream_lines(resp, "/reads"):
             self.stats.add(reads_read=1)

@@ -71,6 +71,21 @@ class VariantsPcaDriver:
         self.source = source
         self.mesh = mesh
         self.index = CallsetIndex.from_source(source, conf.variant_set_ids)
+        self._pin_g_jit = None  # compiled-once G-resharding (pod snapshots)
+
+    def _watchdog(self):
+        """Collective fail-stop guard (utils/watchdog.py), armed only for
+        multi-process runs — a lone process has no peer to lose and must
+        never be shot by a timer. Checkpointed pod ingest arms per ROUND;
+        every other pod collective phase (uncheckpointed ingest, DCN
+        merge, distributed eig) is armed as one phase here, so the flag
+        is never a silent no-op."""
+        from spark_examples_tpu.utils.watchdog import CollectiveWatchdog
+
+        timeout = self.conf.collective_timeout
+        return CollectiveWatchdog(
+            timeout if jax.process_count() > 1 else None
+        )
 
     # -- stage 1: ingest -----------------------------------------------------
 
@@ -276,16 +291,20 @@ class VariantsPcaDriver:
         blocks = blocks_from_calls(
             calls, self.index.size, self.conf.block_variants
         )
-        g = self._blocks_to_gramian(blocks)
-        if jax.process_count() > 1 and not self._mesh_spans_processes():
-            # Host-local accumulation (no global mesh): merge the per-host
-            # partials over DCN. The global-mesh path needs no merge — its
-            # result is already the global G.
-            from spark_examples_tpu.parallel.distributed import (
-                allreduce_gramian,
-            )
+        # One armed phase for the whole uncheckpointed accumulation: the
+        # timeout must budget full ingest (use checkpointed rounds for
+        # finer granularity on long runs).
+        with self._watchdog().armed("ingest+gramian collectives"):
+            g = self._blocks_to_gramian(blocks)
+            if jax.process_count() > 1 and not self._mesh_spans_processes():
+                # Host-local accumulation (no global mesh): merge the
+                # per-host partials over DCN. The global-mesh path needs
+                # no merge — its result is already the global G.
+                from spark_examples_tpu.parallel.distributed import (
+                    allreduce_gramian,
+                )
 
-            g = allreduce_gramian(g)
+                g = allreduce_gramian(g)
         return g
 
     def get_similarity_matrix_stream(self, calls: Iterable[List[int]]):
@@ -395,10 +414,14 @@ class VariantsPcaDriver:
         disagreement — a crash landing between two hosts' saves — discards
         the snapshots with a warning rather than resuming inconsistently.
 
-        The sample-sharded pod regime is excluded: snapshotting a
-        cross-process-sharded G would mean gathering tens of GB per round.
-        Run the stress config without --checkpoint-dir, or checkpoint with
-        the replicated-G layout.
+        The sample-sharded pod regime checkpoints WITHOUT gathering:
+        every host snapshots only its addressable tiles of the
+        cross-process-sharded G (``save_sharded_snapshot``), and resume
+        re-places each tile through the sharding's own index map — so
+        the multi-hour >50k-sample stress runs the reference couldn't
+        reach at all (VariantsPca.scala:176-177) get the same
+        round-granular resume as the replicated layout, at a per-host
+        snapshot cost of one tile set, never one whole G.
         """
         from jax.experimental import multihost_utils
 
@@ -407,41 +430,55 @@ class VariantsPcaDriver:
             load_snapshot,
             save_snapshot,
         )
-
-        if self._sample_sharded():
-            raise ValueError(
-                "checkpointed ingest cannot snapshot a cross-process-"
-                "sharded G (gathering it per round defeats the layout); "
-                "use --no-sample-sharded or drop --checkpoint-dir"
-            )
+        # A lost peer stalls survivors in the next collective forever;
+        # with --collective-timeout each phase is armed fail-stop (exit
+        # 77) so a relaunch can resume all hosts from snapshots instead
+        # of hanging the pod (utils/watchdog.py).
+        wd = self._watchdog()
+        sharded_g = self._sample_sharded()
         vsid = self.conf.variant_set_ids[0]
         mine = self._manifest()
         every = max(1, self.conf.checkpoint_every)
-        lens = np.asarray(
-            multihost_utils.process_allgather(
-                np.array([len(mine)], np.int64)
-            )
-        ).ravel()
+        with wd.armed("manifest-length allgather"):
+            lens = np.asarray(
+                multihost_utils.process_allgather(
+                    np.array([len(mine)], np.int64)
+                )
+            ).ravel()
         total_rounds = int(-(-int(lens.max()) // every))  # ceil
         checkpoint_dir = os.path.join(
             self.conf.checkpoint_dir, f"host-{jax.process_index()}"
         )
         # The digest pins THIS HOST's manifest slice plus its pod-grid
-        # coordinates and round width; cross-host schedule consistency is
-        # NOT the digest's job — the rounds-allgather below enforces it.
+        # coordinates, round width, and (for sharded G) the mesh layout
+        # tiles are keyed to; cross-host schedule consistency is NOT the
+        # digest's job — the rounds-allgather below enforces it.
+        mesh_tag = ""
+        if sharded_g:
+            mesh_tag = "|mesh=" + ",".join(
+                f"{name}:{size}" for name, size in self.mesh.shape.items()
+            )
         digest = (
             f"{manifest_digest(mine)}|{vsid}"
             f"|af={self.conf.min_allele_frequency}"
             f"|pod={jax.process_index()}/{jax.process_count()}|every={every}"
+            f"{mesh_tag}"
         )
         n = self.index.size
-        ck = load_snapshot(checkpoint_dir, digest, n)
-        local_round = ck.shards_done if ck else 0  # cursor counts ROUNDS
-        rounds = np.asarray(
-            multihost_utils.process_allgather(
-                np.array([local_round], np.int64)
+        if sharded_g:
+            local_round, g = self._load_sharded_pod_snapshot(
+                checkpoint_dir, digest, n
             )
-        ).ravel()
+        else:
+            ck = load_snapshot(checkpoint_dir, digest, n)
+            local_round = ck.shards_done if ck else 0  # counts ROUNDS
+            g = ck.g if ck else None
+        with wd.armed("resume-round allgather"):
+            rounds = np.asarray(
+                multihost_utils.process_allgather(
+                    np.array([local_round], np.int64)
+                )
+            ).ravel()
         start = int(rounds.min())
         if int(rounds.max()) != start:
             print(
@@ -450,8 +487,7 @@ class VariantsPcaDriver:
                 "re-ingesting from round 0.",
                 file=sys.stderr,
             )
-            start, ck = 0, None
-        g = ck.g if ck else None
+            start, g = 0, None
         if start:
             print(
                 f"Resuming pod ingest from round {start}/{total_rounds}."
@@ -460,13 +496,90 @@ class VariantsPcaDriver:
             # Collective round: a host whose slice ran short contributes
             # zero-filled steps via the synced stream inside the pod
             # accumulator, so every process executes the same collectives.
-            g = self._ingest_shard_group(
-                vsid, mine[r * every : (r + 1) * every], g
-            )
-            save_snapshot(checkpoint_dir, np.asarray(g), r + 1, digest)
+            # The watchdog budget covers the WHOLE round (host ingest +
+            # collective accumulate + snapshot) — size the timeout off
+            # round wall-clock, not network latency.
+            with wd.armed(f"pod round {r + 1}/{total_rounds}"):
+                g = self._ingest_shard_group(
+                    vsid, mine[r * every : (r + 1) * every], g
+                )
+                if sharded_g:
+                    self._save_sharded_pod_snapshot(
+                        checkpoint_dir, g, r + 1, digest
+                    )
+                else:
+                    save_snapshot(
+                        checkpoint_dir, np.asarray(g), r + 1, digest
+                    )
         if g is None:
             g = self._blocks_to_gramian(iter(()))
         return g
+
+    def _g_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from spark_examples_tpu.parallel.sharded import _mesh_axes
+
+        d_axis, m_axis = _mesh_axes(self.mesh)
+        return NamedSharding(self.mesh, PartitionSpec(d_axis, m_axis))
+
+    def _save_sharded_pod_snapshot(self, directory, g, round_, digest):
+        """Snapshot this host's tiles of the sharded G (no gather).
+
+        The accumulator's trim step leaves layout choice to GSPMD, so G
+        is first pinned to the canonical P(data, model) sharding — a
+        collective jit all hosts execute at the same round — making the
+        tile geometry deterministic for resume.
+        """
+        from spark_examples_tpu.utils.checkpoint import (
+            save_sharded_snapshot,
+        )
+
+        if self._pin_g_jit is None:
+            # Built once per driver: a fresh lambda per round would miss
+            # the jit cache and re-compile the resharding program every
+            # checkpoint round.
+            self._pin_g_jit = jax.jit(
+                lambda a: a, out_shardings=self._g_sharding()
+            )
+        g = self._pin_g_jit(g)
+        save_sharded_snapshot(directory, g, round_, digest)
+
+    def _load_sharded_pod_snapshot(self, directory, digest, n):
+        """→ (rounds_done, sharded G | None) from this host's tile set.
+
+        The stored tiles must cover exactly the CURRENT sharding's
+        addressable indices; any mismatch (different mesh/process
+        placement than the digest caught) discards the snapshot. The
+        rounds value feeds the cross-host agreement check either way.
+        """
+        from spark_examples_tpu.utils.checkpoint import (
+            index_key,
+            load_sharded_snapshot,
+        )
+
+        loaded = load_sharded_snapshot(directory, digest, n)
+        if loaded is None:
+            return 0, None
+        rounds_done, tiles = loaded
+        sharding = self._g_sharding()
+        expected = {
+            index_key(idx, (n, n))
+            for dev, idx in sharding.addressable_devices_indices_map(
+                (n, n)
+            ).items()
+        }
+        if expected != set(tiles):
+            print(
+                "WARNING: sharded snapshot tile set does not match this "
+                "mesh placement; discarding.",
+                file=sys.stderr,
+            )
+            return 0, None
+        g = jax.make_array_from_callback(
+            (n, n), sharding, lambda idx: tiles[index_key(idx, (n, n))]
+        )
+        return rounds_done, g
 
     def _ingest_shard_group(self, vsid: str, group, g):
         """Stream one shard group through filter → calls → Gramian blocks,
@@ -498,6 +611,10 @@ class VariantsPcaDriver:
     # -- stage 5: eigendecomposition ----------------------------------------
 
     def compute_pca(self, g, timer=None) -> List[Tuple[str, float, float]]:
+        with self._watchdog().armed("pca collectives"):
+            return self._compute_pca(g, timer)
+
+    def _compute_pca(self, g, timer=None) -> List[Tuple[str, float, float]]:
         import jax.numpy as jnp
 
         addressable = getattr(g, "is_fully_addressable", True)

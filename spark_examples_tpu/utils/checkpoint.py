@@ -30,9 +30,17 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["GramianCheckpoint", "save_snapshot", "load_snapshot"]
+__all__ = [
+    "GramianCheckpoint",
+    "save_snapshot",
+    "load_snapshot",
+    "save_sharded_snapshot",
+    "load_sharded_snapshot",
+    "index_key",
+]
 
 _SNAP = "gramian_snapshot.npz"
+_SHARDED_SNAP = "gramian_sharded_snapshot.npz"
 
 
 @dataclass(frozen=True)
@@ -61,6 +69,80 @@ def save_snapshot(
             run_digest=np.bytes_(run_digest.encode()),
         )
     os.replace(tmp, os.path.join(directory, _SNAP))
+
+
+def _encode_index(index, shape) -> np.ndarray:
+    """Shard index (tuple of slices) → (ndim, 2) [start, stop) array."""
+    rows = []
+    for sl, dim in zip(index, shape):
+        rows.append(
+            (
+                0 if sl.start is None else int(sl.start),
+                dim if sl.stop is None else int(sl.stop),
+            )
+        )
+    return np.asarray(rows, np.int64)
+
+
+def index_key(index, shape) -> tuple:
+    """Hashable normalized form of a shard index, for lookup tables."""
+    return tuple(map(tuple, _encode_index(index, shape)))
+
+
+def save_sharded_snapshot(
+    directory: str, g, shards_done: int, run_digest: str
+) -> None:
+    """Snapshot THIS process's addressable shards of a mesh-sharded G.
+
+    The sample-sharded stress regime cannot gather G (tens of GB at
+    100k samples — the point of the layout), so each host persists only
+    the tiles it already holds, tagged with their global [start, stop)
+    indices. Together the per-host snapshots tile the full G; resume
+    re-places each tile via the sharding's own index map, so no host
+    ever materializes more than its own share. Same atomic tmp+rename
+    contract as :func:`save_snapshot`.
+    """
+    os.makedirs(directory, exist_ok=True)
+    arrays = {
+        "shards_done": np.int64(shards_done),
+        "run_digest": np.bytes_(run_digest.encode()),
+        "n": np.int64(g.shape[0]),
+    }
+    for i, sh in enumerate(g.addressable_shards):
+        arrays[f"data_{i}"] = np.asarray(sh.data)
+        arrays[f"index_{i}"] = _encode_index(sh.index, g.shape)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
+    with os.fdopen(fd, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    os.replace(tmp, os.path.join(directory, _SHARDED_SNAP))
+
+
+def load_sharded_snapshot(
+    directory: str, run_digest: str, n_samples: int
+) -> Optional[tuple]:
+    """→ ``(shards_done, {index_key: tile})`` or None when stale/absent.
+
+    The caller verifies the stored tile set matches the CURRENT
+    sharding's addressable indices before using it (a changed mesh or
+    process grid also changes the run digest, but the tile-set check
+    keeps the loader safe on its own).
+    """
+    snap_path = os.path.join(directory, _SHARDED_SNAP)
+    if not os.path.exists(snap_path):
+        return None
+    tiles = {}
+    with np.load(snap_path) as z:
+        if (
+            bytes(z["run_digest"]).decode() != run_digest
+            or int(z["n"]) != n_samples
+        ):
+            return None
+        shards_done = int(z["shards_done"])
+        i = 0
+        while f"data_{i}" in z:
+            tiles[tuple(map(tuple, z[f"index_{i}"]))] = z[f"data_{i}"]
+            i += 1
+    return shards_done, tiles
 
 
 def load_snapshot(

@@ -81,6 +81,11 @@ class PcaConfig(GenomicsConfig):
     # N above which the PCoA eigendecomposition switches from dense eigh
     # to randomized subspace iteration (the sharded-eig path).
     dense_eigh_limit: int = 8192
+    # Fail-stop deadline (seconds) per pod collective phase: a lost peer
+    # stalls survivors inside a native collective forever; the watchdog
+    # turns that into a loud exit-77 + snapshot resume (utils/watchdog.py).
+    # None = disabled.
+    collective_timeout: Optional[float] = None
 
 
 def add_genomics_flags(p: argparse.ArgumentParser) -> None:
@@ -166,6 +171,15 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         help="Directory for incremental Gramian snapshots (resume support)",
     )
     p.add_argument("--checkpoint-every", type=int, default=64)
+    p.add_argument(
+        "--collective-timeout",
+        type=float,
+        default=None,
+        help="Fail-stop deadline (seconds) per pod collective phase: a "
+        "lost peer stalls survivors in a native collective forever; with "
+        "this set the process exits 77 instead, and relaunching with the "
+        "same --checkpoint-dir resumes every host from the last round",
+    )
     p.add_argument(
         "--trace-dir",
         default=None,

@@ -1,0 +1,73 @@
+"""Fail-stop watchdog for collective phases — the process-loss answer.
+
+A peer process dying mid-run leaves the survivors blocked inside a
+gloo/XLA collective that Python cannot interrupt: the wait lives in
+native code, so no exception, signal handler, or timeout wrapper in the
+caller can reclaim the thread. The sound remedy is fail-stop — detect
+the stall, kill THIS process loudly with a distinctive exit code, and
+let the operator (or a supervisor) relaunch the job; checkpoint/resume
+then recovers every host from the last collective round
+(``models/pca.py _checkpointed_pod``).
+
+This is the pod-collective analog of the elasticity the reference got
+free from Spark's task re-execution (SURVEY.md §2.10): Spark reschedules
+a lost executor's tasks onto survivors; an SPMD pod cannot — every
+process runs the same collective program — so recovery is
+restart-with-resume, and the watchdog's job is to turn "hang forever"
+into "die in ``timeout`` seconds with a clear diagnostic".
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+from typing import Iterator, Optional
+
+__all__ = ["CollectiveWatchdog", "EXIT_COLLECTIVE_TIMEOUT"]
+
+# Distinctive code so supervisors can tell "peer lost, relaunch me" from
+# ordinary failures (sysexits.h stops at 78; 77 = EX_NOPERM is unused in
+# this codebase).
+EXIT_COLLECTIVE_TIMEOUT = 77
+
+
+class CollectiveWatchdog:
+    """Arms a hard deadline around each collective phase.
+
+    ``timeout_s`` budgets one whole phase INCLUDING its host-side work
+    (a checkpoint round = ingest + collective accumulate + snapshot), so
+    set it to a multiple of the expected round time, not of network
+    latency. ``None``/0 disables arming entirely (the default: a lone
+    process or an interactive run should never be shot by a timer).
+    """
+
+    def __init__(self, timeout_s: Optional[float]):
+        self.timeout_s = timeout_s
+
+    @contextlib.contextmanager
+    def armed(self, what: str) -> Iterator[None]:
+        if not self.timeout_s:
+            yield
+            return
+        timer = threading.Timer(self.timeout_s, self._fire, (what,))
+        timer.daemon = True
+        timer.start()
+        try:
+            yield
+        finally:
+            timer.cancel()
+
+    def _fire(self, what: str) -> None:
+        print(
+            f"FATAL: collective phase '{what}' exceeded "
+            f"{self.timeout_s}s — a peer process is likely lost and the "
+            "collective will never complete. Exiting "
+            f"{EXIT_COLLECTIVE_TIMEOUT}; relaunch the job with the same "
+            "manifest and --checkpoint-dir to resume every host from the "
+            "last snapshotted round.",
+            file=sys.stderr,
+            flush=True,
+        )
+        os._exit(EXIT_COLLECTIVE_TIMEOUT)

@@ -32,12 +32,15 @@ def _free_port() -> int:
     return port
 
 
-def _run_workers(script_path, argv, env_extra=None, n=2, timeout=240):
+def _run_workers(
+    script_path, argv, env_extra=None, n=2, timeout=240, expected_rcs=None
+):
     """Spawn n coordinator-connected worker processes and collect logs.
 
     A dead peer leaves the other blocked in a gloo collective — never
     leak one past the test (it would hold the port for the session).
-    Asserts every worker exits 0.
+    Asserts every worker exits 0, or matches ``expected_rcs`` when the
+    test deliberately kills/fail-stops workers.
     """
     port = _free_port()
     env = {
@@ -64,8 +67,12 @@ def _run_workers(script_path, argv, env_extra=None, n=2, timeout=240):
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    for p, log in zip(procs, logs):
-        assert p.returncode == 0, log[-2000:]
+    if expected_rcs is None:
+        for p, log in zip(procs, logs):
+            assert p.returncode == 0, log[-2000:]
+    else:
+        rcs = [p.returncode for p in procs]
+        assert rcs == list(expected_rcs), (rcs, logs[0][-1500:], logs[1][-1500:])
     return logs
 
 
@@ -579,6 +586,125 @@ def test_sample_sharded_pod_two_processes(tmp_path):
     )
 
 
+_SAMPLE_SHARDED_CHECKPOINT_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from spark_examples_tpu.parallel.distributed import initialize_from_env
+    assert initialize_from_env()
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.genomics.shards import shards_for_references
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    pid = jax.process_index()
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "model"))
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,
+        block_variants=32,
+        checkpoint_dir=sys.argv[2],
+        checkpoint_every=1,
+        sample_sharded=True,
+    )
+    source = synthetic_cohort(24, 96, seed=3)
+    phase = sys.argv[3]
+    driver = VariantsPcaDriver(conf, source, mesh=mesh)
+    assert driver._mesh_spans_processes()
+    assert driver._sample_sharded()
+    if phase == "fail":
+        # EVERY host's second-round shard fails, so both processes raise
+        # before entering that round's collectives (round 1 is already
+        # tile-snapshotted on both).
+        shards = shards_for_references(conf.references, 20_000)
+        mine = shards[pid::2]
+        source._fail_once.add(mine[1])
+        try:
+            driver.get_similarity_matrix_checkpointed()
+            ok = False
+        except IOError:
+            ok = True
+        with open(sys.argv[1] + f".phase1.{pid}", "w") as f:
+            json.dump({"ok": ok}, f)
+    else:
+        g = driver.get_similarity_matrix_checkpointed()
+        assert not g.is_fully_addressable  # still cross-process sharded
+        g_rep = jax.jit(
+            lambda a: a, out_shardings=NamedSharding(mesh, P(None, None))
+        )(g)
+        if pid == 0:
+            with open(sys.argv[1], "w") as f:
+                json.dump(
+                    {"g": np.asarray(g_rep).tolist(),
+                     "partitions": source.stats.partitions}, f
+                )
+    """
+)
+
+
+def test_sample_sharded_pod_checkpoint_resume(tmp_path):
+    """The stress-regime resume drill (round-2 verdict weak #6): G stays
+    cross-process sample-sharded the whole time, every host snapshots
+    only its addressable tiles, a mid-run failure resumes from the last
+    collective round, and the result matches the plain Gramian."""
+    script = tmp_path / "worker.py"
+    script.write_text(_SAMPLE_SHARDED_CHECKPOINT_WORKER)
+    out_file = tmp_path / "result.json"
+    ck_dir = tmp_path / "ck"
+
+    def run_phase(phase):
+        return _run_workers(
+            script,
+            [out_file, ck_dir, phase],
+            env_extra={
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4"
+            },
+        )
+
+    logs = run_phase("fail")
+    for i in range(2):
+        marker = json.loads((tmp_path / f"result.json.phase1.{i}").read_text())
+        assert marker["ok"], logs[i][-2000:]
+    # Tile snapshots, one per host — and no replicated-G snapshot.
+    for i in range(2):
+        host = ck_dir / f"host-{i}"
+        assert (host / "gramian_sharded_snapshot.npz").exists()
+        assert not (host / "gramian_snapshot.npz").exists()
+
+    run_phase("resume")
+    result = json.loads(out_file.read_text())
+    assert result["partitions"] < 3  # resumed, not re-ingested
+
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    plain = VariantsPcaDriver(
+        PcaConfig(
+            variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+            bases_per_partition=20_000,
+            block_variants=32,
+        ),
+        synthetic_cohort(24, 96, seed=3),
+    )
+    data = plain.get_data()
+    calls = plain.get_calls([plain.filter_dataset(d) for d in data])
+    g_plain = np.asarray(plain.get_similarity_matrix(calls))
+    np.testing.assert_array_equal(np.asarray(result["g"]), g_plain)
+
+
 _CHECKPOINT_WORKER = textwrap.dedent(
     """
     import json, os, sys
@@ -660,6 +786,128 @@ def test_two_process_checkpoint_resume(tmp_path):
     assert result["partitions"] < 3
 
     # Golden: single-process, uncheckpointed.
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    plain = VariantsPcaDriver(
+        PcaConfig(
+            variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+            bases_per_partition=20_000,
+            block_variants=32,
+        ),
+        synthetic_cohort(10, 80, seed=5),
+    )
+    data = plain.get_data()
+    calls = plain.get_calls([plain.filter_dataset(d) for d in data])
+    g_plain = np.asarray(plain.get_similarity_matrix(calls))
+    np.testing.assert_array_equal(np.asarray(result["g"]), g_plain)
+
+
+_PROCESS_LOSS_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from spark_examples_tpu.parallel.distributed import initialize_from_env
+    assert initialize_from_env()
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.genomics.shards import shards_for_references
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+    from jax.sharding import Mesh
+
+    pid = jax.process_index()
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "model"))
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,
+        block_variants=32,
+        checkpoint_dir=sys.argv[2],
+        checkpoint_every=1,
+        sample_sharded=False,
+        collective_timeout=10.0,
+    )
+    source = synthetic_cohort(10, 80, seed=5)
+    phase = sys.argv[3]
+    driver = VariantsPcaDriver(conf, source, mesh=mesh)
+    assert driver._mesh_spans_processes()
+    if phase == "wedge":
+        # Process 1 WEDGES at its second-round shard (process alive,
+        # heartbeats flowing — the stall the coordination service cannot
+        # see), after round 1 is snapshotted on both hosts. Its own
+        # watchdog fail-stops it mid-stall; process 0, facing a round-2
+        # collective no peer will ever join, is fail-stopped by ITS
+        # watchdog. Both must exit 77 — never hang.
+        shards = shards_for_references(conf.references, 20_000)
+        mine = shards[pid::2]
+        if pid == 1:
+            import time
+            orig = source._shard_items
+            def wedged(shard):
+                if shard == mine[1]:
+                    time.sleep(120)  # far past the watchdog deadline
+                return orig(shard)
+            source._shard_items = wedged
+        driver.get_similarity_matrix_checkpointed()
+        os._exit(0)  # unreachable for BOTH processes in this phase
+    else:
+        g = np.asarray(driver.get_similarity_matrix_checkpointed())
+        if pid == 0:
+            with open(sys.argv[1], "w") as f:
+                json.dump(
+                    {"g": g.tolist(),
+                     "partitions": source.stats.partitions}, f
+                )
+    """
+)
+
+
+def test_process_loss_fail_stop_and_recovery(tmp_path):
+    """The Spark-elasticity analog drill (round-2 verdict missing #1): an
+    SPMD pod cannot reschedule a lost peer's work onto survivors, so the
+    recovery contract is fail-stop + relaunch-with-resume. True process
+    DEATH is already fail-stop — the JAX coordination service's heartbeat
+    terminates survivors — so the drill exercises the stall heartbeats
+    cannot see: a worker WEDGES mid-ingest, every process's collective
+    watchdog exits 77 with an actionable diagnostic instead of hanging,
+    and relaunching with the same manifest and checkpoint dir resumes all
+    hosts from the last collective round, matching single-process."""
+    script = tmp_path / "worker.py"
+    script.write_text(_PROCESS_LOSS_WORKER)
+    out_file = tmp_path / "result.json"
+    ck_dir = tmp_path / "ck"
+
+    def run_phase(phase, expected_rcs=None):
+        return _run_workers(
+            script,
+            [out_file, ck_dir, phase],
+            env_extra={
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4"
+            },
+            expected_rcs=expected_rcs,
+        )
+
+    logs = run_phase("wedge", expected_rcs=[77, 77])
+    for log in logs:
+        assert "FATAL: collective phase" in log
+        assert "resume" in log  # the diagnostic tells the operator how
+    # Round 1 was snapshotted on both hosts before the loss.
+    assert (ck_dir / "host-0").exists() and (ck_dir / "host-1").exists()
+
+    run_phase("resume")
+    result = json.loads(out_file.read_text())
+    assert result["partitions"] < 3  # resumed from round 1, not round 0
+
     from spark_examples_tpu.genomics.fixtures import (
         DEFAULT_VARIANT_SET_ID,
         synthetic_cohort,

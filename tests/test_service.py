@@ -259,7 +259,7 @@ class TestWireEfficiency:
         src, http = served_cohort
 
         class NoGzip(HttpVariantSource):
-            def _request(self, path, params):
+            def _request(self, path, params, stream=False):
                 import urllib.request
 
                 from spark_examples_tpu.genomics.service import urlencode
