@@ -340,6 +340,9 @@ class HttpVariantSource:
         self._timeout = timeout
         self._cache_dir = cache_dir
         self._mirror = None  # resolved lazily: JsonlSource | False | None
+        # Shard-parallel ingest resolves the mirror from worker threads;
+        # the download must happen exactly once, not raced.
+        self._mirror_lock = threading.Lock()
 
     def _request(self, path: str, params: dict, stream: bool = False):
         url = f"{self.base_url}{path}?{urlencode(params)}"
@@ -376,6 +379,13 @@ class HttpVariantSource:
         if not self._cache_dir:
             self._mirror = False
             return False
+        with self._mirror_lock:
+            if self._mirror is not None:
+                return self._mirror
+            self._mirror = self._resolve_mirror_locked()
+            return self._mirror
+
+    def _resolve_mirror_locked(self):
         try:
             with self._request("/identity", {}) as resp:
                 ident = json.load(resp)["identity"]
@@ -385,7 +395,6 @@ class HttpVariantSource:
             # failure must surface here, not silently disable the cache
             # for a multi-thousand-shard run.
             if _http_code(e) == 404:
-                self._mirror = False
                 return False
             raise
         root = os.path.join(self._cache_dir, f"cohort-{ident}")
@@ -393,8 +402,7 @@ class HttpVariantSource:
             self._download_mirror(root)
         from spark_examples_tpu.genomics.sources import JsonlSource
 
-        self._mirror = JsonlSource(root, stats=self.stats)
-        return self._mirror
+        return JsonlSource(root, stats=self.stats)
 
     def _download_mirror(self, root: str) -> None:
         """Atomically populate ``root`` with the served cohort's

@@ -26,6 +26,7 @@ from __future__ import annotations
 import gzip
 import json
 import os
+import threading
 from dataclasses import dataclass
 from typing import Iterable, Iterator, List, Optional, Protocol, Sequence
 
@@ -395,6 +396,9 @@ class FixtureSource:
         self._variant_idx: Optional[_SortedIndex] = None
         self._read_idx: Optional[_SortedIndex] = None
         self._identity: Optional[str] = None
+        # Served fixtures take concurrent shard requests (threaded HTTP
+        # handlers, shard-parallel clients): build each index once.
+        self._idx_lock = threading.Lock()
 
     @staticmethod
     def _variant_key(item):
@@ -425,9 +429,11 @@ class FixtureSource:
             self.stats.add(io_exceptions=1)
             raise IOError(f"injected stream failure for {shard}")
         if self._variant_idx is None:
-            self._variant_idx = _SortedIndex.build(
-                self._variants, self._variant_key
-            )
+            with self._idx_lock:
+                if self._variant_idx is None:
+                    self._variant_idx = _SortedIndex.build(
+                        self._variants, self._variant_key
+                    )
         return self._variant_idx.slice(shard)
 
     def _built(self, items, variant_set_id: str) -> Iterator[Variant]:
@@ -515,7 +521,11 @@ class FixtureSource:
     ) -> Iterator[Read]:
         self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
         if self._read_idx is None:
-            self._read_idx = _SortedIndex.build(self._reads, self._read_key)
+            with self._idx_lock:
+                if self._read_idx is None:
+                    self._read_idx = _SortedIndex.build(
+                        self._reads, self._read_key
+                    )
         for item in self._read_idx.slice(shard):
             r = item if isinstance(item, Read) else read_from_record(item)
             if (
@@ -654,13 +664,18 @@ class _CsrCohort:
             )
         }
         # Per-query caches: the ordinal→dense-index lookup and the
-        # variant-set mask are identical across a manifest's thousands of
-        # shard queries. Holding the indexes dict itself (not its id)
-        # makes the identity check safe against id reuse.
+        # variant-set masks are identical across a manifest's thousands
+        # of shard queries. Thread-shape matters — shard-parallel ingest
+        # queries this object from worker threads, and the multi-dataset
+        # keyed path interleaves DIFFERENT variant_set_ids concurrently —
+        # so the vsid masks live in a dict keyed by vsid (atomic get/set
+        # under the GIL, values immutable once stored; a racing double
+        # compute yields identical arrays). The lookup cache keeps the
+        # single-slot identity check: every dataset of a run shares one
+        # indexes dict, and the slot is written value-before-key.
         self._lookup_indexes = None
         self._lookup = None
-        self._allowed_vsid = None
-        self._allowed = None
+        self._allowed_by_vsid: dict = {}
 
     @staticmethod
     def _digest(paths) -> str:
@@ -1044,15 +1059,16 @@ class _CsrCohort:
             return
         keep = np.ones(b - a, dtype=bool)
         if variant_set_id:
-            if self._allowed_vsid != variant_set_id:
-                self._allowed = np.array(
+            allowed = self._allowed_by_vsid.get(variant_set_id)
+            if allowed is None:
+                allowed = np.array(
                     [
                         (not v) or v == variant_set_id
                         for v in d["vsids"].tolist()
                     ]
                 )
-                self._allowed_vsid = variant_set_id
-            keep &= self._allowed[d["vcode"][a:b]]
+                self._allowed_by_vsid[variant_set_id] = allowed
+            keep &= allowed[d["vcode"][a:b]]
         stats.add(variants_read=int(keep.sum()))
         if min_af is not None:
             afs = d["afs"][a:b]
@@ -1095,6 +1111,10 @@ class JsonlSource:
         self.root = root
         self.stats = stats if stats is not None else IoStats()
         self._csr: Optional[_CsrCohort] = None
+        # Shard-parallel ingest streams from worker threads; every
+        # lazily-built shared structure (sidecar, record indexes) must be
+        # built exactly once, not once per racing worker.
+        self._lazy_lock = threading.Lock()
         # Parsed-record index: a manifest has O(thousands) of shards
         # (--all-references at 1M bases/shard ≈ 2,900), so re-reading —
         # or even re-scanning — the whole file once per shard would make
@@ -1149,22 +1169,35 @@ class JsonlSource:
                 if line:
                     yield line.encode()
 
+    def _ensure_csr(self) -> _CsrCohort:
+        if self._csr is None:
+            with self._lazy_lock:
+                if self._csr is None:
+                    self._csr = _CsrCohort.load_or_build(
+                        self.root, self._open
+                    )
+        return self._csr
+
     def _variants_index(self) -> _SortedIndex:
         if self._variant_index is None:
-            with self._open("variants.jsonl") as f:
-                self._variant_index = _SortedIndex.build(
-                    (json.loads(line) for line in f),
-                    lambda r: (r["reference_name"], r["start"]),
-                )
+            with self._lazy_lock:
+                if self._variant_index is None:
+                    with self._open("variants.jsonl") as f:
+                        self._variant_index = _SortedIndex.build(
+                            (json.loads(line) for line in f),
+                            lambda r: (r["reference_name"], r["start"]),
+                        )
         return self._variant_index
 
     def _reads_index(self) -> _SortedIndex:
         if self._read_index is None:
-            with self._open("reads.jsonl") as f:
-                self._read_index = _SortedIndex.build(
-                    (json.loads(line) for line in f),
-                    lambda r: (r["reference_name"], r["position"]),
-                )
+            with self._lazy_lock:
+                if self._read_index is None:
+                    with self._open("reads.jsonl") as f:
+                        self._read_index = _SortedIndex.build(
+                            (json.loads(line) for line in f),
+                            lambda r: (r["reference_name"], r["position"]),
+                        )
         return self._read_index
 
     def list_callsets(self, variant_set_id: str) -> List[Callset]:
@@ -1205,9 +1238,7 @@ class JsonlSource:
         first use, reused across shards, runs, and processes — see
         :class:`_CsrCohort`)."""
         self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
-        if self._csr is None:
-            self._csr = _CsrCohort.load_or_build(self.root, self._open)
-        yield from self._csr.carrying(
+        yield from self._ensure_csr().carrying(
             shard,
             indexes,
             variant_set_id,
@@ -1226,8 +1257,7 @@ class JsonlSource:
         precomputed identity-hash column when available (format v2+),
         else from the parsed-record index."""
         self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
-        if self._csr is None:
-            self._csr = _CsrCohort.load_or_build(self.root, self._open)
+        self._ensure_csr()
         if self._csr.has_identity_keys():
             yield from self._csr.carrying_keyed(
                 shard,

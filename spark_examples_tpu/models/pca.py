@@ -174,13 +174,43 @@ class VariantsPcaDriver:
             print(
                 f"Min allele frequency {self.conf.min_allele_frequency}."
             )
-        for shard in shards:
-            yield from self.source.stream_carrying(
-                vsid,
-                shard,
-                self.index.indexes,
-                self.conf.min_allele_frequency,
+        yield from self._parallel_shard_calls(vsid, shards)
+
+    def _ingest_workers(self) -> int:
+        """--ingest-workers, auto = this host's core count (1 → serial)."""
+        if self.conf.ingest_workers:
+            return self.conf.ingest_workers
+        return os.cpu_count() or 1
+
+    def _parallel_shard_calls(
+        self, vsid: str, shards, stream_method=None, workers=None
+    ):
+        """Per-shard extraction lists in EXACT manifest order, produced
+        by N workers (utils/concurrency.py): wall-clock parallelism with
+        bit-identical results — block packing and accumulation order
+        never change. Serial when workers == 1. ``stream_method``
+        defaults to the single-dataset fused stream; the keyed
+        multi-dataset path passes its own."""
+        from spark_examples_tpu.utils.concurrency import (
+            ordered_parallel_map,
+        )
+
+        method = stream_method or self.source.stream_carrying
+
+        def extract(shard):
+            return list(
+                method(
+                    vsid,
+                    shard,
+                    self.index.indexes,
+                    self.conf.min_allele_frequency,
+                )
             )
+
+        for calls in ordered_parallel_map(
+            extract, shards, workers or self._ingest_workers()
+        ):
+            yield from calls
 
     def _fused_multi_possible(self) -> bool:
         """Keyed fused ingest for multi-dataset join/merge: identity
@@ -209,14 +239,21 @@ class VariantsPcaDriver:
                     f"{self.conf.min_allele_frequency}."
                 )
 
+        # One worker pool per dataset stream runs concurrently under
+        # calls_stream_keyed — split the budget so K datasets never
+        # oversubscribe the host K-fold.
+        per_stream = max(
+            1,
+            self._ingest_workers() // len(self.conf.variant_set_ids),
+        )
+
         def keyed(vsid: str):
-            for shard in shards:
-                yield from self.source.stream_carrying_keyed(
-                    vsid,
-                    shard,
-                    self.index.indexes,
-                    self.conf.min_allele_frequency,
-                )
+            yield from self._parallel_shard_calls(
+                vsid,
+                shards,
+                stream_method=self.source.stream_carrying_keyed,
+                workers=per_stream,
+            )
 
         return calls_stream_keyed(
             [keyed(v) for v in self.conf.variant_set_ids],
@@ -587,21 +624,14 @@ class VariantsPcaDriver:
         fused = self._fused_ingest_possible()
 
         def group_calls():
+            if fused:
+                yield from self._parallel_shard_calls(vsid, group)
+                return
             for shard in group:
-                if fused:
-                    yield from self.source.stream_carrying(
-                        vsid,
-                        shard,
-                        self.index.indexes,
-                        self.conf.min_allele_frequency,
-                    )
-                else:
-                    stream = self.filter_dataset(
-                        self.source.stream_variants(vsid, shard)
-                    )
-                    yield from calls_stream(
-                        [stream], self.index.indexes
-                    )
+                stream = self.filter_dataset(
+                    self.source.stream_variants(vsid, shard)
+                )
+                yield from calls_stream([stream], self.index.indexes)
 
         blocks = blocks_from_calls(
             group_calls(), self.index.size, self.conf.block_variants

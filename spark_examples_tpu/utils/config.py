@@ -81,6 +81,10 @@ class PcaConfig(GenomicsConfig):
     # N above which the PCoA eigendecomposition switches from dense eigh
     # to randomized subspace iteration (the sharded-eig path).
     dense_eigh_limit: int = 8192
+    # Shard-parallel host ingest workers (fused paths): 0 = auto (core
+    # count), 1 = serial. Results are bit-identical at any setting — the
+    # ordered map preserves manifest order into the accumulator.
+    ingest_workers: int = 0
     # Fail-stop deadline (seconds) per pod collective phase: a lost peer
     # stalls survivors inside a native collective forever; the watchdog
     # turns that into a loud exit-77 + snapshot resume (utils/watchdog.py).
@@ -171,6 +175,14 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         help="Directory for incremental Gramian snapshots (resume support)",
     )
     p.add_argument("--checkpoint-every", type=int, default=64)
+    p.add_argument(
+        "--ingest-workers",
+        type=int,
+        default=0,
+        help="Threads extracting shards concurrently on the host (fused "
+        "ingest; 0 = one per core, 1 = serial). Results are bit-identical "
+        "at any setting; only wall-clock changes",
+    )
     p.add_argument(
         "--collective-timeout",
         type=float,

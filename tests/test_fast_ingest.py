@@ -630,3 +630,130 @@ class TestRelayHelper:
         assert relay.cpu_failover_if_dead()
         monkeypatch.setattr(relay, "relay_alive", lambda timeout=5.0: True)
         assert not relay.cpu_failover_if_dead()
+
+
+class TestShardParallelIngest:
+    """--ingest-workers: wall-clock parallelism with bit-identical
+    results (round-2 verdict #2 — the shard-parallel cold ingest
+    composition; perf is host-dependent, ORDER is not)."""
+
+    def test_ordered_parallel_map_preserves_order(self):
+        import time
+
+        from spark_examples_tpu.utils.concurrency import (
+            ordered_parallel_map,
+        )
+
+        def slow_square(x):
+            time.sleep(0.002 * (7 - x % 8))  # later items finish earlier
+            return x * x
+
+        items = list(range(40))
+        assert list(ordered_parallel_map(slow_square, items, 8)) == [
+            x * x for x in items
+        ]
+
+    def test_ordered_parallel_map_error_position(self):
+        from spark_examples_tpu.utils.concurrency import (
+            ordered_parallel_map,
+        )
+
+        def boom(x):
+            if x == 5:
+                raise IOError("shard 5 failed")
+            return x
+
+        out = []
+        with pytest.raises(IOError, match="shard 5"):
+            for r in ordered_parallel_map(boom, range(10), 4):
+                out.append(r)
+        assert out == [0, 1, 2, 3, 4]  # everything before the failure
+
+    @pytest.mark.parametrize("workers", [1, 3])
+    def test_driver_results_bit_identical_across_worker_counts(
+        self, tmp_path, workers
+    ):
+        from spark_examples_tpu.models.pca import VariantsPcaDriver
+        from spark_examples_tpu.utils.config import PcaConfig
+
+        _cohort().dump(str(tmp_path / "c"))
+
+        def g_with(n_workers):
+            conf = PcaConfig(
+                variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+                bases_per_partition=20_000,
+                block_variants=32,
+                ingest_workers=n_workers,
+            )
+            driver = VariantsPcaDriver(
+                conf, JsonlSource(str(tmp_path / "c"))
+            )
+            return np.asarray(
+                driver.get_similarity_matrix(driver.get_calls_fused())
+            )
+
+        np.testing.assert_array_equal(g_with(workers), g_with(1))
+
+    def test_multi_dataset_keyed_parallel_bit_identical(self, tmp_path):
+        """The keyed path interleaves DIFFERENT variant sets from
+        concurrent workers against one shared sidecar — the exact shape
+        of the _allowed-mask race the review fixed; results must match
+        serial exactly."""
+        from spark_examples_tpu.models.pca import VariantsPcaDriver
+        from spark_examples_tpu.utils.config import PcaConfig
+
+        a = synthetic_cohort(8, 60, variant_set_id="setA", seed=1)
+        b = synthetic_cohort(8, 60, variant_set_id="setB", seed=1)
+        FixtureSource(
+            variants=a._variants + b._variants,
+            callsets=a._callsets + b._callsets,
+        ).dump(str(tmp_path / "c"))
+
+        def g_with(n_workers):
+            conf = PcaConfig(
+                variant_set_ids=["setA", "setB"],
+                bases_per_partition=20_000,
+                block_variants=32,
+                ingest_workers=n_workers,
+            )
+            driver = VariantsPcaDriver(
+                conf, JsonlSource(str(tmp_path / "c"))
+            )
+            assert driver._fused_multi_possible()
+            return np.asarray(
+                driver.get_similarity_matrix(
+                    driver.get_calls_fused_multi()
+                )
+            )
+
+        np.testing.assert_array_equal(g_with(4), g_with(1))
+
+    def test_http_source_parallel_shards(self):
+        """Concurrent in-flight shard requests against the threaded
+        server — the reference's one-stream-per-task shape."""
+        from spark_examples_tpu.genomics.service import (
+            GenomicsServiceServer,
+            HttpVariantSource,
+        )
+        from spark_examples_tpu.models.pca import VariantsPcaDriver
+        from spark_examples_tpu.utils.config import PcaConfig
+
+        server = GenomicsServiceServer(_cohort()).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+
+            def result_with(n_workers):
+                conf = PcaConfig(
+                    variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+                    bases_per_partition=20_000,
+                    block_variants=32,
+                    ingest_workers=n_workers,
+                )
+                driver = VariantsPcaDriver(conf, HttpVariantSource(url))
+                return np.asarray(
+                    driver.get_similarity_matrix(driver.get_calls_fused())
+                )
+
+            np.testing.assert_array_equal(result_with(4), result_with(1))
+        finally:
+            server.stop()
