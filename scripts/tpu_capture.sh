@@ -20,6 +20,13 @@ if ! curl -s -m 5 http://127.0.0.1:8093/ >/dev/null 2>&1; then
 fi
 echo "relay alive; capturing to $OUT" >&2
 
+# 0. Proof of life FIRST: one JSON line per milestone, flushed — the relay
+#    died ~2 min into round 3 before bench.py could have finished its
+#    compiles; this lands backend evidence inside even a short window.
+timeout 300 python scripts/tpu_quick_probe.py \
+  >"$OUT/quick_probe.jsonl" 2>"$OUT/quick_probe.log"
+echo "quick probe rc=$? ($(wc -l <"$OUT/quick_probe.jsonl" 2>/dev/null) lines)" >&2
+
 # 1. The round's verdict-maker: bench.py on the chip (f32 + int8; the
 #    compilation cache makes the eigh compile a one-time cost).
 timeout 1800 python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"

@@ -1,0 +1,113 @@
+"""Fast TPU proof-of-life: land hardware evidence in under a minute of chip.
+
+The axon relay has died minutes into a session three times (NOTES.md); the
+full bench needs several minutes of compile through the tunnel and may
+never finish inside a short liveness window. This probe emits one JSON
+line per milestone and flushes immediately, so however early the relay
+dies, whatever completed is on disk:
+
+  1. device enumeration (platform + device kind — the "it is a real TPU" fact)
+  2. tiny f32 matmul (compile + steady)
+  3. N=512 int8 Gramian block accumulate (compile + steady + rate)
+  4. N=512 f32 Gramian (compile + steady + rate)
+  5. eigh(512) (compile + steady)
+
+Run it only when the relay is believed alive; there is deliberately NO
+CPU failover here — a hang is the caller's timeout's problem, a CPU
+number would pollute the evidence.
+"""
+
+import json
+import sys
+import time
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def main():
+    import os
+
+    from spark_examples_tpu.utils.compile_cache import compilation_cache_dir
+
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        compilation_cache_dir(
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                ".jax_cache",
+            )
+        ),
+    )
+    import jax.numpy as jnp
+    import numpy as np
+
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    emit(
+        step="devices",
+        platform=jax.default_backend(),
+        devices=[str(d) for d in devs],
+        device_kind=getattr(devs[0], "device_kind", "?"),
+        seconds=round(time.perf_counter() - t0, 3),
+    )
+
+    # 2. tiny matmul
+    x = jnp.ones((128, 128), jnp.float32)
+    t0 = time.perf_counter()
+    (x @ x).block_until_ready()
+    t_compile = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    (x @ x).block_until_ready()
+    emit(
+        step="matmul128_f32",
+        compile_s=round(t_compile, 3),
+        steady_s=round(time.perf_counter() - t0, 5),
+    )
+
+    # 3/4. small Gramian, both dtype modes
+    from spark_examples_tpu.ops import gramian_blockwise
+
+    n, v = 512, 4096
+    rng = np.random.default_rng(0)
+    blocks = [(rng.random((n, v)) < 0.1).astype(np.int8) for _ in range(2)]
+    for name, kw in (
+        ("int8", dict(compute_dtype=jnp.int8, accum_dtype=jnp.int32)),
+        ("f32", {}),
+    ):
+        t0 = time.perf_counter()
+        gramian_blockwise(blocks[:1], n, **kw).block_until_ready()
+        t_compile = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        gramian_blockwise(blocks, n, **kw).block_until_ready()
+        dt = time.perf_counter() - t0
+        emit(
+            step=f"gramian_{name}",
+            n=n,
+            v=2 * v,
+            compile_s=round(t_compile, 3),
+            steady_s=round(dt, 4),
+            samples2_variants_per_s=round(n * n * 2 * v / dt),
+        )
+
+    # 5. eigh at 512 (NOTES: ~15 s compile through the tunnel at this size)
+    g = jnp.asarray(rng.random((n, n)), jnp.float32)
+    g = g + g.T
+    t0 = time.perf_counter()
+    jnp.linalg.eigh(g)[0].block_until_ready()
+    t_compile = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jnp.linalg.eigh(g)[0].block_until_ready()
+    emit(
+        step="eigh512_f32",
+        compile_s=round(t_compile, 3),
+        steady_s=round(time.perf_counter() - t0, 4),
+    )
+    emit(step="done")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

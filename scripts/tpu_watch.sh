@@ -1,0 +1,26 @@
+#!/bin/bash
+# Poll the axon relay; at the FIRST sign of life run the one-shot capture.
+#
+# Rationale: the relay was alive for only ~2 minutes at the start of round
+# 3 (long enough for one jax.devices() — "[TPU v5 lite0]" — then died);
+# every liveness window must trigger the capture immediately, not on the
+# next manual check. Runs until a capture happens, then exits.
+#
+# Usage: bash scripts/tpu_watch.sh [outdir] [poll_seconds]
+
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/tpu_capture}"
+POLL="${2:-60}"
+
+echo "$(date -u +%H:%M:%S) watching relay (poll ${POLL}s)" >&2
+while true; do
+  if curl -s -m 5 -o /dev/null http://127.0.0.1:8093/; then
+    echo "$(date -u +%H:%M:%S) relay ALIVE — starting capture" >&2
+    bash scripts/tpu_capture.sh "$OUT"
+    rc=$?
+    echo "$(date -u +%H:%M:%S) capture finished rc=$rc" >&2
+    exit $rc
+  fi
+  sleep "$POLL"
+done

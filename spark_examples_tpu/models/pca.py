@@ -67,6 +67,19 @@ class VariantsPcaDriver:
             # Validate before any ingest work — failing in stage 5 would
             # waste the whole (potentially hours-long) Gramian pass.
             raise ValueError(f"--num-pc must be >= 1, got {conf.num_pc}")
+        if conf.elastic_checkpoint:
+            # A checkpoint flag that silently does nothing loses the user
+            # hours of presumed-checkpointed work — refuse up front.
+            if not conf.checkpoint_dir:
+                raise ValueError(
+                    "--elastic-checkpoint requires --checkpoint-dir"
+                )
+            if len(conf.variant_set_ids) != 1:
+                raise ValueError(
+                    "--elastic-checkpoint supports a single variantset "
+                    "(checkpointed ingest cannot cut the N-way identity "
+                    "merge at shard boundaries)"
+                )
         self.conf = conf
         self.source = source
         self.mesh = mesh
@@ -117,16 +130,18 @@ class VariantsPcaDriver:
             return shards[jax.process_index() :: jax.process_count()]
         return shards
 
-    def _manifest(self):
-        """This process's shard manifest — the ONE place the partitioner
-        parameters live, so fused/staged/checkpointed ingest can never
-        disagree on what they ingest."""
-        return self._host_shards(
-            self.conf.shards(
-                all_references=self.conf.all_references,
-                sex_filter=SexChromosomeFilter.EXCLUDE_XY,
-            )
+    def _global_manifest(self):
+        """The full, unsliced shard manifest — the ONE place the
+        partitioner parameters live, so fused/staged/checkpointed/elastic
+        ingest can never disagree on what they ingest."""
+        return self.conf.shards(
+            all_references=self.conf.all_references,
+            sex_filter=SexChromosomeFilter.EXCLUDE_XY,
         )
+
+    def _manifest(self):
+        """This process's shard manifest slice."""
+        return self._host_shards(self._global_manifest())
 
     # -- stage 2: filters ----------------------------------------------------
 
@@ -177,10 +192,17 @@ class VariantsPcaDriver:
         yield from self._parallel_shard_calls(vsid, shards)
 
     def _ingest_workers(self) -> int:
-        """--ingest-workers, auto = this host's core count (1 → serial)."""
+        """--ingest-workers; auto = core count capped at 16 (1 → serial).
+
+        The cap bounds peak host memory: each in-flight worker holds one
+        shard's materialized call lists (ordered_parallel_map keeps
+        workers+2 results buffered), so an uncapped auto on a 96-core TPU
+        VM could hold ~100 shards of call data at once. Users who have
+        the RAM opt into more with an explicit --ingest-workers.
+        """
         if self.conf.ingest_workers:
             return self.conf.ingest_workers
-        return os.cpu_count() or 1
+        return min(os.cpu_count() or 1, 16)
 
     def _parallel_shard_calls(
         self, vsid: str, shards, stream_method=None, workers=None
@@ -392,6 +414,8 @@ class VariantsPcaDriver:
         assert len(self.conf.variant_set_ids) == 1, (
             "checkpointed ingest supports a single variantset"
         )
+        if self.conf.elastic_checkpoint:
+            return self._checkpointed_elastic()
         if self._mesh_spans_processes():
             return self._checkpointed_pod()
         vsid = self.conf.variant_set_ids[0]
@@ -436,6 +460,171 @@ class VariantsPcaDriver:
             )
 
             g = allreduce_gramian(jax.numpy.asarray(g))
+        return g
+
+    def _checkpointed_elastic(self):
+        """Elastic ingest: Spark-task-style work units, any-world-size resume.
+
+        The reference delegates straggler/executor-loss recovery to Spark
+        task re-execution (SURVEY.md §2.10 elasticity row;
+        ``VariantsRDD.scala:163-165`` merely counts failures). This is the
+        TPU-native analog (utils/elastic.py): the GLOBAL manifest is cut
+        into fixed units of ``checkpoint_every`` shards; each process
+        accumulates its units into a self-describing lane snapshot; resume
+        at ANY process count claims surviving lanes and re-slices the
+        uncovered units over the live hosts — so a dead host's remaining
+        share is re-executed by survivors instead of freezing the job.
+
+        Host-local (DP) accumulation regime only: pod-mode collectives
+        need every process in lockstep on one mesh, which is exactly the
+        coupling elasticity removes — use the synced-round pod
+        checkpointing there. Multi-host elastic runs require the
+        checkpoint dir on a shared filesystem (verified by fingerprint
+        allgather before any work).
+        """
+        from jax.experimental import multihost_utils
+
+        from spark_examples_tpu.genomics.shards import manifest_digest
+        from spark_examples_tpu.utils import elastic
+
+        if self._mesh_spans_processes():
+            raise ValueError(
+                "--elastic-checkpoint applies to the host-local (DP) "
+                "accumulation regime; a process-spanning mesh needs the "
+                "fixed-grid pod checkpointing (omit --elastic-checkpoint)"
+            )
+        vsid = self.conf.variant_set_ids[0]
+        shards_all = self._global_manifest()
+        every = max(1, self.conf.checkpoint_every)
+        digest = (
+            f"{manifest_digest(shards_all)}|{vsid}"
+            f"|af={self.conf.min_allele_frequency}|every={every}|elastic"
+        )
+        n = self.index.size
+        directory = os.path.join(self.conf.checkpoint_dir, "elastic")
+        p, world = jax.process_index(), jax.process_count()
+        if world > 1:
+            # Write-probe FIRST: on a first run every host sees zero lanes,
+            # so a lane fingerprint alone cannot distinguish a shared dir
+            # from per-host local disks — and discovering that only after
+            # a crash strands each host's lanes. Every process drops a
+            # token, barriers, then must see every peer's token.
+            os.makedirs(directory, exist_ok=True)
+            token = os.path.join(directory, f".probe-{p}")
+            with open(token, "w") as f:
+                f.write(str(p))
+            with self._watchdog().armed("elastic shared-dir probe"):
+                multihost_utils.process_allgather(
+                    np.array([p], np.int64)
+                )
+            missing = [
+                i
+                for i in range(world)
+                if not os.path.exists(
+                    os.path.join(directory, f".probe-{i}")
+                )
+            ]
+            # Exchange miss counts BEFORE deleting tokens (allgather syncs
+            # entry, not exit — deleting first lets a fast host remove its
+            # token before a slow host checks) and fail on EVERY host when
+            # ANY host missed: a one-sided raise would strand the passing
+            # hosts in the next collective.
+            with self._watchdog().armed("elastic shared-dir probe (exit)"):
+                misses = np.asarray(
+                    multihost_utils.process_allgather(
+                        np.array([len(missing)], np.int64)
+                    )
+                ).ravel()
+            try:
+                os.remove(token)
+            except OSError:
+                pass
+            if int(misses.max()) > 0:
+                detail = (
+                    f"this host cannot see the probe file(s) of "
+                    f"process(es) {missing}; "
+                    if missing
+                    else ""
+                )
+                raise RuntimeError(
+                    "elastic multi-host checkpointing requires "
+                    "--checkpoint-dir on a filesystem every host shares; "
+                    f"{detail}probe miss counts per process: "
+                    f"{misses.tolist()}"
+                )
+        lanes = elastic.load_lanes(directory, digest, n)
+        if world > 1:
+            fp = bytes.fromhex(elastic.lane_view_fingerprint(lanes))
+            with self._watchdog().armed("elastic lane-view agreement"):
+                views = np.asarray(
+                    multihost_utils.process_allgather(
+                        np.frombuffer(fp, dtype=np.uint8)
+                    )
+                ).reshape(world, -1)
+            if not (views == views[0]).all():
+                raise RuntimeError(
+                    "elastic checkpoint lanes differ across hosts — "
+                    "--checkpoint-dir must be on a filesystem every host "
+                    "shares for elastic multi-host resume"
+                )
+        if p == 0:
+            # One host prunes digest-orphaned and superseded lane files
+            # (safe: every host finished reading lanes at the agreement
+            # barrier above; single-process runs have no reader to race).
+            elastic.prune_stale_lanes(directory, digest, lanes)
+        units = elastic.unit_ranges(len(shards_all), every)
+        done = set()
+        for lane in lanes:
+            done |= lane.units
+        remaining = [u for u in range(len(units)) if u not in done]
+        # Deterministic claim: sorted lanes and uncovered units round-robin
+        # over the CURRENT world size — the same rule at any world size, so
+        # a relaunch with fewer (or more) hosts just re-deals the work.
+        my_lanes = lanes[p::world]
+        my_units = remaining[p::world]
+        if done:
+            print(
+                f"Elastic resume: {len(done)}/{len(units)} units already "
+                f"covered by {len(lanes)} lane(s); this process claims "
+                f"{len(my_lanes)} lane(s) + {len(my_units)} new unit(s)."
+            )
+        g = None
+        covered = set()
+        for lane in my_lanes:
+            covered |= lane.units
+            g = lane.g.copy() if g is None else g + lane.g
+        own_paths = [lane.path for lane in my_lanes]
+        for u in my_units:
+            lo, hi = units[u]
+            g = np.asarray(
+                self._ingest_shard_group(vsid, shards_all[lo:hi], g)
+            )
+            covered.add(u)
+            own_paths = [
+                elastic.merge_and_supersede(
+                    directory, g, covered, digest, own_paths
+                )
+            ]
+        if g is None:
+            g = self._blocks_to_gramian(iter(()))
+        else:
+            g = jax.numpy.asarray(g)
+        if world > 1:
+            from spark_examples_tpu.parallel.distributed import (
+                allreduce_gramian,
+            )
+
+            # Unlike pod mode's per-round arming, elastic hosts ingest
+            # WITHOUT any sync until this single merge — the first host
+            # done waits here for the slowest, so --collective-timeout
+            # must budget the whole-run ingest skew (uneven unit deals
+            # are routine), not collective latency. The phase name says
+            # so, so a fired watchdog diagnostic explains itself.
+            with self._watchdog().armed(
+                "elastic final allreduce (deadline must cover ingest "
+                "skew across hosts — slowest minus fastest host)"
+            ):
+                g = allreduce_gramian(g)
         return g
 
     def _checkpointed_pod(self):

@@ -72,6 +72,11 @@ class PcaConfig(GenomicsConfig):
     precise: bool = False  # host-f64 eigendecomposition (driver-side LAPACK analog)
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 64  # shards per Gramian snapshot
+    # World-size-independent checkpointing (utils/elastic.py): work units
+    # over the GLOBAL manifest + self-describing lane snapshots, so resume
+    # works on any number of hosts and survivors re-execute a dead host's
+    # remaining units — the Spark task re-execution analog.
+    elastic_checkpoint: bool = False
     trace_dir: Optional[str] = None  # jax.profiler trace output
     # The 100k-sample stress regime (BASELINE.md config #5): shard the N×N
     # Gramian over the mesh instead of replicating it. None = auto (shard
@@ -176,12 +181,23 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument("--checkpoint-every", type=int, default=64)
     p.add_argument(
+        "--elastic-checkpoint",
+        action="store_true",
+        help="World-size-independent checkpointing: fixed work units over "
+        "the GLOBAL manifest with self-describing lane snapshots, so a "
+        "crashed or shrunken cluster resumes on ANY number of hosts and "
+        "survivors re-execute a dead host's remaining units (the Spark "
+        "task re-execution analog). Multi-host runs need --checkpoint-dir "
+        "on a shared filesystem; host-local (DP) accumulation regime only",
+    )
+    p.add_argument(
         "--ingest-workers",
         type=int,
         default=0,
         help="Threads extracting shards concurrently on the host (fused "
-        "ingest; 0 = one per core, 1 = serial). Results are bit-identical "
-        "at any setting; only wall-clock changes",
+        "ingest; 0 = auto, one per core capped at 16 to bound peak memory; "
+        "1 = serial). Results are bit-identical at any setting; only "
+        "wall-clock changes",
     )
     p.add_argument(
         "--collective-timeout",
@@ -190,7 +206,10 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         help="Fail-stop deadline (seconds) per pod collective phase: a "
         "lost peer stalls survivors in a native collective forever; with "
         "this set the process exits 77 instead, and relaunching with the "
-        "same --checkpoint-dir resumes every host from the last round",
+        "same --checkpoint-dir resumes every host from the last round. "
+        "Pod mode arms each synced round; elastic mode arms only the "
+        "final partial-G merge, so there the deadline must budget the "
+        "whole-run ingest skew between the fastest and slowest host",
     )
     p.add_argument(
         "--trace-dir",

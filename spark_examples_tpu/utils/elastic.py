@@ -1,0 +1,251 @@
+"""Elastic checkpointing: world-size-independent work units and lanes.
+
+The Spark-task-re-execution analog. Spark reschedules a lost executor's
+tasks onto survivors for free; the reference leans on that entirely and
+itself only *counts* failures (``VariantsRDD.scala:163-165``, SURVEY.md
+§2.10 elasticity row). The non-elastic checkpoint modes here key their
+snapshots to the process grid (``host=p/P`` digests over per-host manifest
+slices), so recovery demands a relaunch with the SAME world size — a dead
+host freezes its share of the work. Elastic mode removes the coupling:
+
+- The **global** manifest is cut into fixed work units of
+  ``checkpoint_every`` shards — the analog of a Spark task. Unit
+  boundaries depend only on the manifest and the round width, never on
+  how many processes exist.
+- Each process accumulates its units into a **lane**: one ``.npz``
+  holding a partial Gramian plus the exact unit-id set it covers. A lane
+  is self-describing — any reader knows precisely what work it holds.
+- Resume (at ANY world size): list the shared checkpoint dir, drop lanes
+  whose unit set is contained in another lane's (the merge protocol's
+  only crash residue — see below), deterministically claim surviving
+  lanes round-robin, and re-slice the units no lane covers over the
+  CURRENT processes. A dead host's unfinished share is thereby
+  re-executed by survivors: Spark's elasticity without a cluster manager.
+
+Crash-safety protocol: a process merges its claimed lanes plus each newly
+finished unit into a NEW lane file (atomic tmp+rename), and only then
+deletes the lanes the new file supersedes. A crash at any instant leaves
+either the old lanes intact, or the merged lane alongside stale subset
+lanes — never a torn file, never a unit counted twice after the
+subset-discard pass. Lanes never partially overlap under this protocol;
+if one ever does (external corruption), it is discarded loudly.
+
+Multi-host elastic mode requires the checkpoint dir to be on a filesystem
+all hosts share (the driver verifies the view cross-host before work
+begins). This mirrors Spark, whose recovery also runs through shared
+state (the driver's lineage + a shared shuffle/storage layer).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import uuid
+from dataclasses import dataclass
+from typing import FrozenSet, List, Optional, Sequence, Tuple
+from zipfile import BadZipFile
+
+import numpy as np
+
+__all__ = [
+    "Lane",
+    "unit_ranges",
+    "save_lane",
+    "load_lanes",
+    "merge_and_supersede",
+]
+
+_LANE_PREFIX = "lane-"
+_LANE_SUFFIX = ".npz"
+
+
+@dataclass(frozen=True)
+class Lane:
+    path: str
+    units: FrozenSet[int]
+    g: np.ndarray
+
+
+def unit_ranges(n_shards: int, every: int) -> List[Tuple[int, int]]:
+    """Global manifest → work-unit shard ranges ``[start, stop)``.
+
+    Pure function of (manifest length, round width): the same units exist
+    no matter how many processes compute them — the property that makes
+    resume world-size independent.
+    """
+    every = max(1, every)
+    return [
+        (lo, min(lo + every, n_shards)) for lo in range(0, n_shards, every)
+    ]
+
+
+def save_lane(
+    directory: str,
+    g,
+    units: Sequence[int],
+    run_digest: str,
+) -> str:
+    """Write one lane atomically (tmp + rename); returns its path."""
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
+    with os.fdopen(fd, "wb") as f:
+        np.savez_compressed(
+            f,
+            g=np.asarray(g),
+            units=np.asarray(sorted(units), np.int64),
+            run_digest=np.bytes_(run_digest.encode()),
+        )
+    path = os.path.join(
+        directory, f"{_LANE_PREFIX}{uuid.uuid4().hex}{_LANE_SUFFIX}"
+    )
+    os.replace(tmp, path)
+    return path
+
+
+def _read_lane(path: str, run_digest: str, n: int) -> Optional[Lane]:
+    try:
+        with np.load(path) as z:
+            if bytes(z["run_digest"]).decode() != run_digest:
+                return None
+            g = z["g"]
+            if g.shape != (n, n):
+                return None
+            return Lane(
+                path=path,
+                units=frozenset(int(u) for u in z["units"]),
+                g=g,
+            )
+    except (OSError, KeyError, ValueError, BadZipFile):
+        # A torn write cannot exist (atomic rename), but an unreadable
+        # file from any other source must not kill resume — its work is
+        # simply re-executed.
+        print(
+            f"WARNING: unreadable elastic lane {path}; ignoring.",
+            file=sys.stderr,
+        )
+        return None
+
+
+def load_lanes(directory: str, run_digest: str, n: int) -> List[Lane]:
+    """All usable lanes, deterministically de-overlapped.
+
+    Candidates sort by descending unit-count then name, so a merged
+    superset lane always wins over the stale subsets it replaced; a lane
+    overlapping the kept set in any *partial* way cannot arise from the
+    merge protocol and is discarded with a warning. The result is a list
+    of pairwise-disjoint lanes, identical on every host that sees the
+    same directory.
+    """
+    if not os.path.isdir(directory):
+        return []
+    candidates = []
+    for name in sorted(os.listdir(directory)):
+        if not (name.startswith(_LANE_PREFIX) and name.endswith(_LANE_SUFFIX)):
+            continue
+        lane = _read_lane(os.path.join(directory, name), run_digest, n)
+        if lane is not None:
+            candidates.append(lane)
+    candidates.sort(key=lambda l: (-len(l.units), os.path.basename(l.path)))
+    kept: List[Lane] = []
+    covered: set = set()
+    for lane in candidates:
+        if lane.units.isdisjoint(covered):
+            kept.append(lane)
+            covered |= lane.units
+        elif lane.units <= covered:
+            continue  # stale subset left by a crash inside a merge
+        else:
+            print(
+                f"WARNING: elastic lane {lane.path} partially overlaps "
+                "other lanes (corruption?); discarding it — its units "
+                "will be re-executed.",
+                file=sys.stderr,
+            )
+    return kept
+
+
+def merge_and_supersede(
+    directory: str,
+    g,
+    units: Sequence[int],
+    run_digest: str,
+    supersedes: Sequence[str],
+) -> str:
+    """Atomically publish a merged lane, then delete the lanes it replaces.
+
+    Write-new-then-delete-old ordering is the crash-safety invariant: the
+    merged lane's unit set is a superset of every superseded lane's, so a
+    crash between the two steps leaves only subset lanes for
+    :func:`load_lanes` to discard.
+    """
+    path = save_lane(directory, g, units, run_digest)
+    for old in supersedes:
+        if os.path.abspath(old) == os.path.abspath(path):
+            continue
+        try:
+            os.remove(old)
+        except OSError:
+            pass  # already gone — deletion is best-effort cleanup
+    return path
+
+
+def prune_stale_lanes(
+    directory: str, run_digest: str, kept: Sequence[Lane]
+) -> int:
+    """Delete lane files that are provably worthless for this run.
+
+    Every parameter change (AF filter, round width, manifest) mints a new
+    digest and orphans the previous run's lanes — one compressed (N, N)
+    Gramian each, so an un-pruned checkpoint dir grows without bound.
+    Removed: lanes that read cleanly but carry a different digest, and
+    lanes whose unit set the kept lanes already cover (merge-crash
+    residue). Unreadable files are deliberately LEFT in place — they are
+    evidence of corruption, and deleting them would hide it. Returns the
+    number of files removed.
+    """
+    kept_paths = {os.path.abspath(lane.path) for lane in kept}
+    covered: set = set()
+    for lane in kept:
+        covered |= lane.units
+    removed = 0
+    if not os.path.isdir(directory):
+        return 0
+    for name in sorted(os.listdir(directory)):
+        if not (name.startswith(_LANE_PREFIX) and name.endswith(_LANE_SUFFIX)):
+            continue
+        path = os.path.join(directory, name)
+        if os.path.abspath(path) in kept_paths:
+            continue
+        try:
+            with np.load(path) as z:
+                digest = bytes(z["run_digest"]).decode()
+                units = frozenset(int(u) for u in z["units"])
+        except (OSError, KeyError, ValueError, BadZipFile):
+            continue  # unreadable: keep as corruption evidence
+        if digest != run_digest or units <= covered:
+            try:
+                os.remove(path)
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
+
+def lane_view_fingerprint(lanes: Sequence[Lane]) -> str:
+    """Order-independent digest of (lane name, unit set) pairs.
+
+    Multi-host elastic resume requires every process to see the SAME
+    lanes (shared checkpoint dir); the driver allgathers this fingerprint
+    and refuses to proceed on divergence, turning a mis-mounted
+    checkpoint dir into a loud error instead of a wrong Gramian.
+    """
+    import hashlib
+
+    h = hashlib.sha256()
+    for lane in sorted(lanes, key=lambda l: os.path.basename(l.path)):
+        h.update(os.path.basename(lane.path).encode())
+        h.update(b":")
+        h.update(",".join(map(str, sorted(lane.units))).encode())
+        h.update(b";")
+    return h.hexdigest()

@@ -1,0 +1,494 @@
+"""Elastic checkpointing: Spark-task-analog units, any-world-size resume.
+
+The reference gets executor-loss recovery free from Spark task
+re-execution (SURVEY.md §2.10 elasticity row); the non-elastic checkpoint
+modes here pin snapshots to the process grid, so a shrunken cluster could
+not resume them. These tests pin the elastic contract:
+
+- lane snapshots are atomic, self-describing, and de-overlap
+  deterministically after any crash window of the merge protocol;
+- a single-process elastic run matches the plain pipeline bit-for-bit and
+  resume never re-ingests covered units;
+- THE DRILL: a two-process run where one worker dies permanently
+  mid-ingest fail-stops (never hangs), and a relaunch with ONE process
+  claims both processes' lanes, re-executes only the dead worker's
+  remaining units, and matches the uninterrupted single-process result
+  bit-for-bit — the dead host's manifest share is re-sliced onto the
+  survivor, which Spark calls task re-execution.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.genomics.fixtures import (
+    DEFAULT_VARIANT_SET_ID,
+    synthetic_cohort,
+)
+from spark_examples_tpu.genomics.shards import shards_for_references
+from spark_examples_tpu.models.pca import VariantsPcaDriver
+from spark_examples_tpu.utils import elastic
+from spark_examples_tpu.utils.config import PcaConfig
+
+
+class TestUnitRanges:
+    def test_exact_division(self):
+        assert elastic.unit_ranges(6, 2) == [(0, 2), (2, 4), (4, 6)]
+
+    def test_ragged_tail(self):
+        assert elastic.unit_ranges(5, 2) == [(0, 2), (2, 4), (4, 5)]
+
+    def test_every_clamped_to_one(self):
+        assert elastic.unit_ranges(2, 0) == [(0, 1), (1, 2)]
+
+    def test_empty_manifest(self):
+        assert elastic.unit_ranges(0, 4) == []
+
+
+class TestLanes:
+    def test_roundtrip(self, tmp_path):
+        g = np.arange(9.0, dtype=np.float32).reshape(3, 3)
+        elastic.save_lane(str(tmp_path), g, [2, 0], "d1")
+        lanes = elastic.load_lanes(str(tmp_path), "d1", 3)
+        assert len(lanes) == 1
+        assert lanes[0].units == frozenset({0, 2})
+        np.testing.assert_array_equal(lanes[0].g, g)
+
+    def test_digest_and_shape_mismatch_ignored(self, tmp_path):
+        elastic.save_lane(str(tmp_path), np.zeros((3, 3)), [0], "d1")
+        assert elastic.load_lanes(str(tmp_path), "other", 3) == []
+        assert elastic.load_lanes(str(tmp_path), "d1", 4) == []
+
+    def test_absent_dir(self, tmp_path):
+        assert elastic.load_lanes(str(tmp_path / "nope"), "d", 3) == []
+
+    def test_subset_discarded(self, tmp_path):
+        """The merge-protocol crash residue: superset lane + stale subsets
+        → only the superset survives, each unit counted once."""
+        g1 = np.ones((2, 2), np.float32)
+        elastic.save_lane(str(tmp_path), g1, [0], "d")
+        elastic.save_lane(str(tmp_path), g1, [1], "d")
+        elastic.save_lane(str(tmp_path), 3 * g1, [0, 1], "d")  # merged
+        lanes = elastic.load_lanes(str(tmp_path), "d", 2)
+        assert len(lanes) == 1
+        assert lanes[0].units == frozenset({0, 1})
+        np.testing.assert_array_equal(lanes[0].g, 3 * g1)
+
+    def test_partial_overlap_discarded_with_warning(self, tmp_path, capsys):
+        g = np.ones((2, 2), np.float32)
+        elastic.save_lane(str(tmp_path), g, [0, 1], "d")
+        elastic.save_lane(str(tmp_path), g, [1, 2], "d")  # cannot arise
+        lanes = elastic.load_lanes(str(tmp_path), "d", 2)
+        assert len(lanes) == 1
+        assert "partially overlaps" in capsys.readouterr().err
+
+    def test_unreadable_lane_ignored(self, tmp_path, capsys):
+        (tmp_path / "lane-deadbeef.npz").write_bytes(b"not a zip")
+        elastic.save_lane(str(tmp_path), np.zeros((2, 2)), [0], "d")
+        lanes = elastic.load_lanes(str(tmp_path), "d", 2)
+        assert len(lanes) == 1
+        assert "unreadable" in capsys.readouterr().err
+
+    def test_merge_supersede_deletes_old(self, tmp_path):
+        g = np.ones((2, 2), np.float32)
+        p1 = elastic.save_lane(str(tmp_path), g, [0], "d")
+        p2 = elastic.save_lane(str(tmp_path), g, [1], "d")
+        merged = elastic.merge_and_supersede(
+            str(tmp_path), 2 * g, [0, 1], "d", [p1, p2]
+        )
+        assert os.path.exists(merged)
+        assert not os.path.exists(p1) and not os.path.exists(p2)
+        lanes = elastic.load_lanes(str(tmp_path), "d", 2)
+        assert len(lanes) == 1 and lanes[0].units == frozenset({0, 1})
+
+    def test_prune_stale_lanes(self, tmp_path):
+        g = np.ones((2, 2), np.float32)
+        old = elastic.save_lane(str(tmp_path), g, [0], "old-digest")
+        sub = elastic.save_lane(str(tmp_path), g, [1], "d")
+        live = elastic.save_lane(str(tmp_path), 2 * g, [1, 2], "d")
+        bad = tmp_path / "lane-ffff.npz"
+        bad.write_bytes(b"garbage")
+        kept = elastic.load_lanes(str(tmp_path), "d", 2)
+        removed = elastic.prune_stale_lanes(str(tmp_path), "d", kept)
+        assert removed == 2  # stale digest + superseded subset
+        assert not os.path.exists(old) and not os.path.exists(sub)
+        assert os.path.exists(live)
+        assert bad.exists()  # unreadable files stay as evidence
+
+    def test_fingerprint_order_independent(self, tmp_path):
+        g = np.zeros((2, 2))
+        elastic.save_lane(str(tmp_path), g, [0], "d")
+        elastic.save_lane(str(tmp_path), g, [1], "d")
+        lanes = elastic.load_lanes(str(tmp_path), "d", 2)
+        assert elastic.lane_view_fingerprint(
+            lanes
+        ) == elastic.lane_view_fingerprint(list(reversed(lanes)))
+
+
+def _conf(tmp_path, **kw):
+    base = dict(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,  # BRCA1 region → 5 shards
+        block_variants=64,
+        checkpoint_dir=str(tmp_path / "ck"),
+        checkpoint_every=2,  # → 3 units: [0,2) [2,4) [4,5)
+        elastic_checkpoint=True,
+    )
+    base.update(kw)
+    return PcaConfig(**base)
+
+
+def _plain_gramian(n=12, v=100):
+    driver = VariantsPcaDriver(
+        PcaConfig(
+            variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+            bases_per_partition=20_000,
+            block_variants=64,
+        ),
+        synthetic_cohort(n, v),
+    )
+    data = driver.get_data()
+    calls = driver.get_calls([driver.filter_dataset(d) for d in data])
+    return np.asarray(driver.get_similarity_matrix(calls))
+
+
+class TestElasticValidation:
+    def test_requires_checkpoint_dir(self):
+        conf = PcaConfig(
+            variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+            elastic_checkpoint=True,
+        )
+        with pytest.raises(ValueError, match="--checkpoint-dir"):
+            VariantsPcaDriver(conf, synthetic_cohort(4, 10))
+
+    def test_requires_single_variantset(self, tmp_path):
+        conf = PcaConfig(
+            variant_set_ids=["a", "b"],
+            checkpoint_dir=str(tmp_path),
+            elastic_checkpoint=True,
+        )
+        with pytest.raises(ValueError, match="single variantset"):
+            VariantsPcaDriver(conf, synthetic_cohort(4, 10))
+
+
+class TestElasticPipeline:
+    def test_matches_plain(self, tmp_path):
+        driver = VariantsPcaDriver(_conf(tmp_path), synthetic_cohort(12, 100))
+        g = np.asarray(driver.get_similarity_matrix_checkpointed())
+        np.testing.assert_array_equal(g, _plain_gramian())
+
+    def test_resume_skips_covered_units(self, tmp_path):
+        conf = _conf(tmp_path)
+        g1 = np.asarray(
+            VariantsPcaDriver(
+                conf, synthetic_cohort(12, 100)
+            ).get_similarity_matrix_checkpointed()
+        )
+        src2 = synthetic_cohort(12, 100)
+        g2 = np.asarray(
+            VariantsPcaDriver(
+                conf, src2
+            ).get_similarity_matrix_checkpointed()
+        )
+        assert src2.stats.partitions == 0  # nothing re-streamed
+        np.testing.assert_array_equal(g1, g2)
+
+    def test_resume_after_failure_matches_plain(self, tmp_path):
+        conf = _conf(tmp_path)
+        shards = shards_for_references(conf.references, 20_000)
+        src = synthetic_cohort(12, 100)
+        src._fail_once.add(shards[3])  # inside unit 1 ([2,4))
+        with pytest.raises(IOError):
+            VariantsPcaDriver(
+                conf, src
+            ).get_similarity_matrix_checkpointed()
+        # Unit 0 completed and is on disk as a lane.
+        lanes = os.listdir(os.path.join(conf.checkpoint_dir, "elastic"))
+        assert len(lanes) == 1
+
+        src2 = synthetic_cohort(12, 100)
+        g = np.asarray(
+            VariantsPcaDriver(
+                conf, src2
+            ).get_similarity_matrix_checkpointed()
+        )
+        # Units 1 and 2 re-ingested (3 shards), unit 0's 2 shards skipped.
+        assert src2.stats.partitions == 3
+        np.testing.assert_array_equal(g, _plain_gramian())
+
+    def test_changed_round_width_invalidates(self, tmp_path):
+        """Unit boundaries depend on checkpoint_every; the digest pins it
+        so lanes from a different width are never mixed in."""
+        conf = _conf(tmp_path)
+        VariantsPcaDriver(
+            conf, synthetic_cohort(12, 100)
+        ).get_similarity_matrix_checkpointed()
+        conf2 = _conf(tmp_path, checkpoint_every=3)
+        src = synthetic_cohort(12, 100)
+        g = np.asarray(
+            VariantsPcaDriver(
+                conf2, src
+            ).get_similarity_matrix_checkpointed()
+        )
+        assert src.stats.partitions == 5  # full re-ingest, no stale reuse
+        np.testing.assert_array_equal(g, _plain_gramian())
+        # The old width's lanes were pruned — only the new run's remain.
+        lane_files = [
+            f
+            for f in os.listdir(
+                os.path.join(conf2.checkpoint_dir, "elastic")
+            )
+            if f.startswith("lane-")
+        ]
+        assert len(lane_files) == 1
+
+    def test_full_driver_run_elastic(self, tmp_path):
+        result = VariantsPcaDriver(
+            _conf(tmp_path), synthetic_cohort(15, 120)
+        ).run()
+        plain = VariantsPcaDriver(
+            PcaConfig(
+                variant_set_ids=[DEFAULT_VARIANT_SET_ID], block_variants=64
+            ),
+            synthetic_cohort(15, 120),
+        ).run()
+        np.testing.assert_allclose(
+            np.array([r[1:] for r in result]),
+            np.array([r[1:] for r in plain]),
+            atol=1e-4,
+        )
+
+
+# ---------------------------------------------------------------------------
+# THE DRILL: two processes, one dies permanently, one-process resume.
+# ---------------------------------------------------------------------------
+
+pytestmark_multihost = pytest.mark.skipif(
+    os.environ.get("SPARK_EXAMPLES_TPU_SKIP_MULTIHOST") == "1",
+    reason="multihost tests disabled",
+)
+
+_SHRINK_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from spark_examples_tpu.parallel.distributed import initialize_from_env
+    assert initialize_from_env()
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.genomics.shards import shards_for_references
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    pid = jax.process_index()
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,
+        block_variants=32,
+        checkpoint_dir=sys.argv[1],
+        checkpoint_every=1,  # 5 shards -> 5 units
+        elastic_checkpoint=True,
+        collective_timeout=8.0,
+    )
+    source = synthetic_cohort(10, 80, seed=5)
+    if pid == 1:
+        # Permanent death mid-ingest: process 1's units are 1 and 3; it
+        # finishes unit 1 (lane on disk), then dies at unit 3's shard.
+        shards = shards_for_references(conf.references, 20_000)
+        orig = source._shard_items
+        def dying(shard):
+            if shard == shards[3]:
+                os._exit(13)
+            return orig(shard)
+        source._shard_items = dying
+    driver = VariantsPcaDriver(conf, source)
+    driver.get_similarity_matrix_checkpointed()
+    os._exit(0)  # unreachable: pid 1 dies; pid 0 fail-stops in allreduce
+    """
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytestmark_multihost
+def test_elastic_shrink_world_resume(tmp_path):
+    """A worker dies for good mid-run; the survivor fail-stops rather than
+    hanging; relaunching with HALF the world size resumes from both
+    processes' lanes and re-executes only the dead worker's remaining
+    unit. Final Gramian is bit-equal to the uninterrupted pipeline."""
+    script = tmp_path / "worker.py"
+    script.write_text(_SHRINK_WORKER)
+    ck_dir = tmp_path / "ck"
+
+    port = _free_port()
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ),
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "JAX_NUM_PROCESSES": "2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(ck_dir)],
+            env={**env, "JAX_PROCESS_ID": str(i)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for i in range(2)
+    ]
+    try:
+        logs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    # Process 1 died on purpose; process 0 must NOT hang or succeed — the
+    # collective watchdog (exit 77) or the coordination-service heartbeat
+    # terminates it, whichever fires first.
+    assert procs[1].returncode == 13, logs[1][-1500:]
+    assert procs[0].returncode not in (0, None), logs[0][-1500:]
+
+    # Lanes on disk: process 0 covered units {0,2,4}, process 1 covered
+    # {1} before dying — unit 3 is the only one left.
+    lanes = elastic.load_lanes(
+        str(ck_dir / "elastic"), _drill_digest(), 10
+    )
+    covered = set()
+    for lane in lanes:
+        covered |= lane.units
+    assert covered == {0, 1, 2, 4}
+
+    # Resume at world size ONE: claims all lanes, ingests only unit 3.
+    src = synthetic_cohort(10, 80, seed=5)
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,
+        block_variants=32,
+        checkpoint_dir=str(ck_dir),
+        checkpoint_every=1,
+        elastic_checkpoint=True,
+    )
+    g = np.asarray(
+        VariantsPcaDriver(conf, src).get_similarity_matrix_checkpointed()
+    )
+    assert src.stats.partitions == 1  # exactly the dead worker's unit
+
+    plain = VariantsPcaDriver(
+        PcaConfig(
+            variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+            bases_per_partition=20_000,
+            block_variants=32,
+        ),
+        synthetic_cohort(10, 80, seed=5),
+    )
+    data = plain.get_data()
+    calls = plain.get_calls([plain.filter_dataset(d) for d in data])
+    g_plain = np.asarray(plain.get_similarity_matrix(calls))
+    np.testing.assert_array_equal(g, g_plain)
+
+
+_UNSHARED_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from spark_examples_tpu.parallel.distributed import initialize_from_env
+    assert initialize_from_env()
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    # Each process gets its OWN checkpoint dir — the misconfiguration the
+    # write-probe must catch BEFORE any ingest happens.
+    conf = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        bases_per_partition=20_000,
+        block_variants=32,
+        checkpoint_dir=sys.argv[1] + f"-{jax.process_index()}",
+        checkpoint_every=1,
+        elastic_checkpoint=True,
+        collective_timeout=30.0,
+    )
+    source = synthetic_cohort(10, 80, seed=5)
+    try:
+        VariantsPcaDriver(conf, source).get_similarity_matrix_checkpointed()
+    except RuntimeError as e:
+        assert "probe" in str(e), e
+        assert source.stats.partitions == 0  # caught before any ingest
+        os._exit(21)
+    os._exit(0)
+    """
+)
+
+
+@pytestmark_multihost
+def test_elastic_unshared_dir_detected_before_work(tmp_path):
+    """A checkpoint dir that is not actually shared must be detected by
+    the write-probe BEFORE any ingest — not after a crash, when each
+    host's lanes would already be stranded on local disks."""
+    script = tmp_path / "worker.py"
+    script.write_text(_UNSHARED_WORKER)
+
+    port = _free_port()
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ),
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "JAX_NUM_PROCESSES": "2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(tmp_path / "ck")],
+            env={**env, "JAX_PROCESS_ID": str(i)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for i in range(2)
+    ]
+    try:
+        logs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert [p.returncode for p in procs] == [21, 21], (
+        logs[0][-1500:],
+        logs[1][-1500:],
+    )
+
+
+def _drill_digest() -> str:
+    from spark_examples_tpu.genomics.shards import manifest_digest
+
+    shards = shards_for_references("17:41196311:41277499", 20_000)
+    return (
+        f"{manifest_digest(shards)}|{DEFAULT_VARIANT_SET_ID}"
+        f"|af=None|every=1|elastic"
+    )
