@@ -14,6 +14,16 @@ must never block headless multi-host startup: confirmation is only
 requested when the process is the coordinator AND stdin is a TTY;
 non-interactive contexts fail closed with an instructive error instead of
 hanging a pod.
+
+Credential files come in two shapes, both on either path:
+
+- ``{"token": ...}`` — a pre-exchanged access token, used as-is;
+- ``{"client_id", "client_secret", "refresh_token"[, "token_uri"]}`` —
+  a stored user credential (the ``authorized_user`` shape ``gcloud``
+  writes for ADC, optionally nested under ``"installed"``), exchanged for
+  a live access token via the OAuth refresh-token grant
+  (:mod:`spark_examples_tpu.genomics.oauth`) — the reference's
+  ``CredentialFactory`` leg (``Client.scala:42``).
 """
 
 from __future__ import annotations
@@ -50,6 +60,57 @@ _WARNING = (
 )
 
 
+def _credential_shape(secrets: dict, path: str, origin: str) -> dict:
+    """Validate the file's structure; → the flattened credential dict.
+
+    Purely local (no network): callers on the interactive path run this
+    BEFORE the confirmation prompt, so a structurally useless file is an
+    AuthError up front — never a warning the user confirms only to watch
+    it error, and never a misleading headless diagnostic about TTYs when
+    the real problem is the file. Accepts the flat shape or Google's
+    ``"installed"`` nesting.
+    """
+    flat = secrets.get("installed", secrets)
+    if not isinstance(flat, dict):
+        raise AuthError(f"{origin} {path}: 'installed' must be an object")
+    if flat.get("token") or secrets.get("token"):
+        return flat
+    if all(
+        flat.get(k)
+        for k in ("client_id", "client_secret", "refresh_token")
+    ):
+        return flat
+    raise AuthError(
+        f"{origin} {path} has neither a 'token' entry nor a full "
+        "refresh credential (client_id + client_secret + refresh_token); "
+        "a client_id alone is public identity, not a secret — store an "
+        "authorized_user credential or a pre-exchanged token"
+    )
+
+
+def _resolve_token(secrets: dict, flat: dict) -> str:
+    """Validated credential → live access token (pre-exchanged or OAuth).
+
+    An explicit ``token`` wins (already exchanged); otherwise the
+    ``authorized_user`` triple runs the refresh-token grant against the
+    file's ``token_uri`` (``Client.scala:42`` CredentialFactory leg).
+    """
+    token = flat.get("token") or secrets.get("token")
+    if token:
+        return token
+    from spark_examples_tpu.genomics.oauth import (
+        GOOGLE_TOKEN_URI,
+        exchange_refresh_token,
+    )
+
+    return exchange_refresh_token(
+        flat["client_id"],
+        flat["client_secret"],
+        flat["refresh_token"],
+        token_uri=flat.get("token_uri", GOOGLE_TOKEN_URI),
+    )
+
+
 def get_access_token(
     client_secrets_path: Optional[str] = None,
     interactive: Optional[bool] = None,
@@ -58,9 +119,11 @@ def get_access_token(
     """Resolve credentials — Authentication.getAccessToken semantics.
 
     Args:
-      client_secrets_path: path to a JSON file with an explicit ``token``
-        entry (client_id-only files are rejected — no OAuth exchange flow
-        exists here); triggers the visibility warning + confirmation.
+      client_secrets_path: path to a JSON credential file (see module
+        docstring for the two accepted shapes); triggers the visibility
+        warning + confirmation, and any OAuth exchange happens only AFTER
+        the user confirms (the reference also warns before building the
+        credential, Client.scala:32-42).
       interactive: force/deny the confirmation prompt; default = stdin is
         a TTY. (Deliberately never queries jax: multi-host worker
         processes have no TTY, so they fail closed; touching
@@ -77,17 +140,13 @@ def get_access_token(
             raise AuthError(
                 f"cannot read client secrets {client_secrets_path}: {e}"
             ) from e
-        # Only an explicit 'token' authenticates: a client_id is public
-        # identity, not a secret, and treating it as a credential would
-        # hand the confirmed-visible "credential" zero actual access
-        # (the reference runs a full OAuth user flow here).
-        token = secrets.get("token")
-        if not token:
-            raise AuthError(
-                f"{client_secrets_path} has no 'token' entry; client_id-only "
-                "secrets files are unsupported (no OAuth flow in this "
-                "framework — pre-exchange the token)"
-            )
+        # Structural validation BEFORE the prompt (and before the
+        # headless fail-closed check): a useless file must error as a
+        # file problem, not a TTY problem. The OAuth exchange itself
+        # still only happens after the user confirms.
+        flat = _credential_shape(
+            secrets, client_secrets_path, "client secrets"
+        )
         if interactive is None:
             interactive = sys.stdin.isatty()
         if not interactive:
@@ -99,19 +158,24 @@ def get_access_token(
         answer = _input(_WARNING).strip().lower()
         if answer not in ("", "y", "yes"):
             raise AuthError("user declined client-secrets credential")
-        return Credentials(token=token, source="client-secrets")
+        return Credentials(
+            token=_resolve_token(secrets, flat), source="client-secrets"
+        )
 
     adc = os.environ.get(ADC_ENV)
     if adc:
-        # The variable must name a readable token-bearing JSON file; an
+        # The variable must name a readable credential JSON file; an
         # explicitly configured credential silently degrading to
-        # anonymous would be worse than failing.
+        # anonymous would be worse than failing. No confirmation on this
+        # path — ADC is ambient by definition (Client.scala:44).
         try:
             with open(adc) as f:
-                token = json.load(f).get("token", "")
+                secrets = json.load(f)
         except (OSError, json.JSONDecodeError) as e:
             raise AuthError(f"cannot read {ADC_ENV}={adc}: {e}") from e
-        if not token:
-            raise AuthError(f"{ADC_ENV}={adc} has no 'token' entry")
-        return Credentials(token=token, source="application-default")
+        flat = _credential_shape(secrets, adc, ADC_ENV)
+        return Credentials(
+            token=_resolve_token(secrets, flat),
+            source="application-default",
+        )
     return Credentials(token="", source="anonymous")

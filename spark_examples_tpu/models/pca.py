@@ -591,8 +591,11 @@ class VariantsPcaDriver:
         g = None
         covered = set()
         for lane in my_lanes:
+            # Payloads load lazily: only CLAIMED lanes' Gramians ever
+            # reach this host's memory (listing loaded metadata alone).
             covered |= lane.units
-            g = lane.g.copy() if g is None else g + lane.g
+            lane_g = lane.load_g()
+            g = lane_g if g is None else g + lane_g
         own_paths = [lane.path for lane in my_lanes]
         for u in my_units:
             lo, hi = units[u]

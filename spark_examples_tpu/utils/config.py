@@ -107,9 +107,11 @@ def add_genomics_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--client-secrets",
         default=None,
-        help="Client-secrets JSON for network-source auth (interactive "
-        "confirmation required, Client.scala:32-41 semantics); offline "
-        "sources ignore it",
+        help="Credential JSON for network-source auth (interactive "
+        "confirmation required, Client.scala:32-41 semantics): either a "
+        "pre-exchanged {'token': ...} or a stored OAuth user credential "
+        "(client_id + client_secret + refresh_token, exchanged at "
+        "startup via the refresh-token grant); offline sources ignore it",
     )
     p.add_argument(
         "--api-url",

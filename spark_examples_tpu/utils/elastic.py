@@ -62,9 +62,17 @@ _LANE_SUFFIX = ".npz"
 
 @dataclass(frozen=True)
 class Lane:
+    """One lane's metadata. The Gramian payload is NOT held here: at the
+    stress scale (100k samples) one lane's float32 G is ~40 GB, and every
+    host lists ALL lanes but needs the payload of only its claimed ones —
+    so listing loads unit sets and payloads load on demand."""
+
     path: str
     units: FrozenSet[int]
-    g: np.ndarray
+
+    def load_g(self) -> np.ndarray:
+        with np.load(self.path) as z:
+            return z["g"]
 
 
 def unit_ranges(n_shards: int, every: int) -> List[Tuple[int, int]]:
@@ -88,11 +96,15 @@ def save_lane(
 ) -> str:
     """Write one lane atomically (tmp + rename); returns its path."""
     os.makedirs(directory, exist_ok=True)
+    g = np.asarray(g)
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
     with os.fdopen(fd, "wb") as f:
+        # g_shape is stored separately so readers can validate a lane
+        # without decompressing the (N, N) payload member.
         np.savez_compressed(
             f,
-            g=np.asarray(g),
+            g=g,
+            g_shape=np.asarray(g.shape, np.int64),
             units=np.asarray(sorted(units), np.int64),
             run_digest=np.bytes_(run_digest.encode()),
         )
@@ -105,16 +117,16 @@ def save_lane(
 
 def _read_lane(path: str, run_digest: str, n: int) -> Optional[Lane]:
     try:
+        # npz members decompress individually — digest/units/shape checks
+        # never pull the (N, N) payload into memory.
         with np.load(path) as z:
             if bytes(z["run_digest"]).decode() != run_digest:
                 return None
-            g = z["g"]
-            if g.shape != (n, n):
+            if tuple(z["g_shape"]) != (n, n):
                 return None
             return Lane(
                 path=path,
                 units=frozenset(int(u) for u in z["units"]),
-                g=g,
             )
     except (OSError, KeyError, ValueError, BadZipFile):
         # A torn write cannot exist (atomic rename), but an unreadable
@@ -191,19 +203,27 @@ def merge_and_supersede(
 
 
 def prune_stale_lanes(
-    directory: str, run_digest: str, kept: Sequence[Lane]
+    directory: str,
+    run_digest: str,
+    kept: Sequence[Lane],
+    tmp_ttl_seconds: float = 3600.0,
 ) -> int:
     """Delete lane files that are provably worthless for this run.
 
     Every parameter change (AF filter, round width, manifest) mints a new
     digest and orphans the previous run's lanes — one compressed (N, N)
     Gramian each, so an un-pruned checkpoint dir grows without bound.
-    Removed: lanes that read cleanly but carry a different digest, and
-    lanes whose unit set the kept lanes already cover (merge-crash
-    residue). Unreadable files are deliberately LEFT in place — they are
+    Removed: lanes that read cleanly but carry a different digest, lanes
+    whose unit set the kept lanes already cover (merge-crash residue),
+    and ``.npz.tmp`` orphans from a save that was killed mid-write —
+    age-gated by ``tmp_ttl_seconds`` so a peer's save actively in flight
+    on the shared dir is never yanked out from under it. Unreadable
+    ``lane-*.npz`` files are deliberately LEFT in place — they are
     evidence of corruption, and deleting them would hide it. Returns the
     number of files removed.
     """
+    import time
+
     kept_paths = {os.path.abspath(lane.path) for lane in kept}
     covered: set = set()
     for lane in kept:
@@ -211,7 +231,17 @@ def prune_stale_lanes(
     removed = 0
     if not os.path.isdir(directory):
         return 0
+    now = time.time()
     for name in sorted(os.listdir(directory)):
+        if name.endswith(".npz.tmp"):
+            path = os.path.join(directory, name)
+            try:
+                if now - os.path.getmtime(path) > tmp_ttl_seconds:
+                    os.remove(path)
+                    removed += 1
+            except OSError:
+                pass
+            continue
         if not (name.startswith(_LANE_PREFIX) and name.endswith(_LANE_SUFFIX)):
             continue
         path = os.path.join(directory, name)

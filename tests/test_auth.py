@@ -34,10 +34,22 @@ class TestClientSecrets:
         # it as a token would produce a confirmed-but-useless credential.
         f = tmp_path / "secrets.json"
         f.write_text(json.dumps({"client_id": "abc.apps.example"}))
-        with pytest.raises(AuthError, match="no 'token'"):
+        prompts = []
+        with pytest.raises(AuthError, match="neither a 'token'"):
             get_access_token(
-                str(f), interactive=True, _input=lambda prompt: "y"
+                str(f),
+                interactive=True,
+                _input=lambda prompt: prompts.append(prompt) or "y",
             )
+        assert prompts == []  # structurally useless: rejected pre-prompt
+
+    def test_client_id_only_headless_names_the_file_problem(self, tmp_path):
+        """Headless + useless file must error about the FILE, not about
+        TTYs/ADC — the user would otherwise debug the wrong thing."""
+        f = tmp_path / "secrets.json"
+        f.write_text(json.dumps({"client_id": "abc.apps.example"}))
+        with pytest.raises(AuthError, match="neither a 'token'"):
+            get_access_token(str(f), interactive=False)
 
     def test_interactive_decline_raises(self, tmp_path):
         f = tmp_path / "secrets.json"
@@ -71,7 +83,7 @@ class TestApplicationDefault:
         f = tmp_path / "sa.json"
         f.write_text(json.dumps({"private_key": "x", "client_email": "y"}))
         monkeypatch.setenv(ADC_ENV, str(f))
-        with pytest.raises(AuthError, match="no 'token'"):
+        with pytest.raises(AuthError, match="neither a 'token'"):
             get_access_token()
 
     def test_adc_bad_path_fails_loud(self, monkeypatch):

@@ -58,7 +58,7 @@ class TestLanes:
         lanes = elastic.load_lanes(str(tmp_path), "d1", 3)
         assert len(lanes) == 1
         assert lanes[0].units == frozenset({0, 2})
-        np.testing.assert_array_equal(lanes[0].g, g)
+        np.testing.assert_array_equal(lanes[0].load_g(), g)
 
     def test_digest_and_shape_mismatch_ignored(self, tmp_path):
         elastic.save_lane(str(tmp_path), np.zeros((3, 3)), [0], "d1")
@@ -78,7 +78,7 @@ class TestLanes:
         lanes = elastic.load_lanes(str(tmp_path), "d", 2)
         assert len(lanes) == 1
         assert lanes[0].units == frozenset({0, 1})
-        np.testing.assert_array_equal(lanes[0].g, 3 * g1)
+        np.testing.assert_array_equal(lanes[0].load_g(), 3 * g1)
 
     def test_partial_overlap_discarded_with_warning(self, tmp_path, capsys):
         g = np.ones((2, 2), np.float32)
@@ -120,6 +120,19 @@ class TestLanes:
         assert not os.path.exists(old) and not os.path.exists(sub)
         assert os.path.exists(live)
         assert bad.exists()  # unreadable files stay as evidence
+
+    def test_prune_tmp_orphans_age_gated(self, tmp_path):
+        """A save killed mid-write leaves a .npz.tmp orphan; prune removes
+        it once it is clearly not an in-flight peer write."""
+        stale = tmp_path / "tmpabc123.npz.tmp"
+        stale.write_bytes(b"half-written")
+        os.utime(stale, (1, 1))  # ancient
+        fresh = tmp_path / "tmpdef456.npz.tmp"
+        fresh.write_bytes(b"in flight")
+        removed = elastic.prune_stale_lanes(str(tmp_path), "d", [])
+        assert removed == 1
+        assert not stale.exists()
+        assert fresh.exists()  # could be a live peer's write — kept
 
     def test_fingerprint_order_independent(self, tmp_path):
         g = np.zeros((2, 2))
