@@ -85,6 +85,7 @@ class VariantsPcaDriver:
         self.mesh = mesh
         self.index = CallsetIndex.from_source(source, conf.variant_set_ids)
         self._pin_g_jit = None  # compiled-once G-resharding (pod snapshots)
+        self._speculated_shards = 0  # straggler duplicates launched
 
     def _watchdog(self):
         """Collective fail-stop guard (utils/watchdog.py), armed only for
@@ -229,8 +230,20 @@ class VariantsPcaDriver:
                 )
             )
 
+        def note_speculation(shard):
+            self._speculated_shards += 1
+            print(
+                f"Speculating straggler shard {shard} "
+                "(duplicate attempt launched).",
+                file=sys.stderr,
+            )
+
         for calls in ordered_parallel_map(
-            extract, shards, workers or self._ingest_workers()
+            extract,
+            shards,
+            workers or self._ingest_workers(),
+            speculate=self.conf.speculative_ingest,
+            on_speculate=note_speculation,
         ):
             yield from calls
 
@@ -590,13 +603,35 @@ class VariantsPcaDriver:
             )
         g = None
         covered = set()
+        own_paths = []
         for lane in my_lanes:
             # Payloads load lazily: only CLAIMED lanes' Gramians ever
             # reach this host's memory (listing loaded metadata alone).
+            # A payload that fails to decompress (metadata read fine but
+            # the g member is corrupt) must not kill resume: this process
+            # claimed the lane, so it re-executes the lane's units and
+            # the corrupt file is superseded at the next merge.
+            try:
+                lane_g = lane.load_g()
+            except Exception as e:  # noqa: BLE001 — any corruption shape
+                print(
+                    f"WARNING: claimed lane {lane.path} payload is "
+                    f"unreadable ({type(e).__name__}: {e}); re-executing "
+                    f"its {len(lane.units)} unit(s).",
+                    file=sys.stderr,
+                )
+                my_units = my_units + sorted(lane.units)
+                own_paths.append(lane.path)
+                continue
             covered |= lane.units
-            lane_g = lane.load_g()
-            g = lane_g if g is None else g + lane_g
-        own_paths = [lane.path for lane in my_lanes]
+            own_paths.append(lane.path)
+            if g is None:
+                # Fresh private array from np.load: in-place accumulation
+                # is safe and keeps the peak at two (N, N) arrays, not
+                # three — at stress scale each is tens of GB.
+                g = lane_g
+            else:
+                g += lane_g
         for u in my_units:
             lo, hi = units[u]
             g = np.asarray(
@@ -943,6 +978,14 @@ class VariantsPcaDriver:
     # -- observability -------------------------------------------------------
 
     def report_io_stats(self) -> None:
+        if self._speculated_shards:
+            # Host-local observability line (Spark logs speculation per
+            # executor; this is the per-host analog).
+            print(
+                f"Speculative shard attempts on this host: "
+                f"{self._speculated_shards}.",
+                file=sys.stderr,
+            )
         stats = getattr(self.source, "stats", None)
         if stats is None:
             return

@@ -18,12 +18,35 @@ call lists flow to the accumulator without serialization.
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, Iterator, Sequence, TypeVar
+import statistics
+import time
+from typing import Callable, Iterable, Iterator, Optional, Sequence, TypeVar
 
 T = TypeVar("T")
 R = TypeVar("R")
 
 __all__ = ["ordered_parallel_map"]
+
+# Spark speculates a task at 1.5× the stage median once a quantile of
+# tasks completed; extraction durations here are far noisier than Spark's
+# cluster tasks (sidecar mmap hits vs HTTP round-trips), so the default
+# multiplier is more conservative and the floor avoids speculating
+# millisecond shards on scheduler jitter.
+SPECULATION_MULTIPLIER = 4.0
+SPECULATION_MIN_COMPLETED = 6
+SPECULATION_FLOOR_SECONDS = 0.05
+
+
+class _Attempt:
+    """One submitted extraction: its future plus the in-thread start time
+    (None until a pool thread actually begins — queue time must not count
+    toward straggler detection)."""
+
+    __slots__ = ("future", "started")
+
+    def __init__(self):
+        self.future = None
+        self.started = None
 
 
 def ordered_parallel_map(
@@ -31,6 +54,8 @@ def ordered_parallel_map(
     items: Sequence[T] | Iterable[T],
     workers: int,
     lookahead: int = 2,
+    speculate: bool = False,
+    on_speculate: Optional[Callable[[T], None]] = None,
 ) -> Iterator[R]:
     """Yield ``fn(item)`` in input order, computing up to ``workers``
     items concurrently with at most ``workers + lookahead`` in flight
@@ -41,6 +66,20 @@ def ordered_parallel_map(
     A worker exception surfaces at the position of ITS item (in-order,
     like the serial loop would), after which iteration stops; remaining
     in-flight work is abandoned to the executor's shutdown.
+
+    ``speculate=True`` adds Spark-style speculative execution (the
+    straggler half of Spark's elasticity; task re-execution for LOST
+    work is the elastic checkpoint layer's job): when the head-of-line
+    item — the only one blocking output — has been RUNNING longer than
+    ``SPECULATION_MULTIPLIER`` × the median completed duration (with at
+    least ``SPECULATION_MIN_COMPLETED`` samples), a duplicate attempt
+    launches on a spare thread and whichever attempt finishes first
+    wins. Extraction is idempotent and deterministic, so both attempts
+    produce identical results and the winner's identity cannot change
+    the output. A failed attempt defers to the survivor — speculation
+    doubles as a retry when the original dies slowly — and the failure
+    only surfaces if BOTH attempts fail. ``on_speculate(item)`` fires at
+    each launch (observability: the driver counts these).
     """
     if workers <= 1:
         for item in items:
@@ -48,19 +87,103 @@ def ordered_parallel_map(
         return
 
     import collections
-    from concurrent.futures import ThreadPoolExecutor
+    from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+
+    durations: list = []
+
+    def submit(pool, item) -> _Attempt:
+        att = _Attempt()
+
+        def run():
+            att.started = time.monotonic()
+            out = fn(item)
+            durations.append(time.monotonic() - att.started)
+            return out
+
+        att.future = pool.submit(run)
+        return att
+
+    def drain_head(head_item, head: _Attempt, spare_pool) -> R:
+        """Block for the head-of-line result, speculating if it lags."""
+        attempts = [head]
+        while True:
+            # Check EVERY attempt for a winner at the top of the loop —
+            # not just the futures the last wait() reported. An attempt
+            # can complete in the gap between a wait() timeout (where a
+            # speculation launches) and the next wait set construction;
+            # checking only newly-done futures would silently drop that
+            # winner and block on the loser.
+            for a in attempts:
+                if a.future.done() and a.future.exception() is None:
+                    return a.future.result()
+            # Wait ONLY on unfinished attempts: a completed-failed future
+            # left in the wait set would make wait() return instantly
+            # every iteration — a 100%-CPU spin for as long as the
+            # survivor runs.
+            live = [a for a in attempts if not a.future.done()]
+            if not live:
+                # Every attempt failed; surface the ORIGINAL's error
+                # (in-order semantics).
+                return attempts[0].future.result()
+            deadline = None
+            timeout = None
+            if speculate and len(attempts) == 1:
+                if (
+                    len(durations) >= SPECULATION_MIN_COMPLETED
+                    and head.started is not None
+                ):
+                    threshold = max(
+                        SPECULATION_MULTIPLIER
+                        * statistics.median(tuple(durations)),
+                        SPECULATION_FLOOR_SECONDS,
+                    )
+                    deadline = head.started + threshold
+                    timeout = max(0.0, deadline - time.monotonic())
+                else:
+                    # Not yet eligible; re-check as siblings complete.
+                    timeout = 0.1
+            wait(
+                {a.future for a in live},
+                timeout=timeout,
+                return_when=FIRST_COMPLETED,
+            )
+            # The top-of-loop scan handles whatever completed (winner →
+            # return; failure → dropped from the next wait set).
+            if (
+                deadline is not None
+                and time.monotonic() >= deadline
+                and len(attempts) == 1
+                and not head.future.done()
+            ):
+                # Deadline passed with the head still running: speculate.
+                if on_speculate is not None:
+                    on_speculate(head_item)
+                attempts.append(submit(spare_pool, head_item))
 
     window = workers + max(0, lookahead)
-    with ThreadPoolExecutor(max_workers=workers) as pool:
+    with ThreadPoolExecutor(max_workers=workers) as pool, ThreadPoolExecutor(
+        max_workers=workers, thread_name_prefix="speculate"
+    ) as spare:
+        # The spare pool exists so a speculative attempt starts
+        # immediately instead of queueing behind the main pool's backlog
+        # (Spark launches speculative copies on free executors). It is
+        # sized like the main pool, not 1: an abandoned duplicate whose
+        # original won keeps running until its IO completes, and a
+        # single-thread spare would let one such zombie silently queue
+        # every later speculation behind it. Generator exhaustion joins
+        # all attempts (pool shutdown waits), so a wedged abandoned
+        # duplicate delays RETURN, never correctness — sources put
+        # timeouts on their IO for exactly this reason.
         pending = collections.deque()
-        it = iter(items)
         try:
-            for item in it:
-                pending.append(pool.submit(fn, item))
+            for item in items:
+                pending.append((item, submit(pool, item)))
                 if len(pending) >= window:
-                    yield pending.popleft().result()
+                    head_item, head = pending.popleft()
+                    yield drain_head(head_item, head, spare)
             while pending:
-                yield pending.popleft().result()
+                head_item, head = pending.popleft()
+                yield drain_head(head_item, head, spare)
         finally:
-            for f in pending:
-                f.cancel()
+            for _, att in pending:
+                att.future.cancel()

@@ -87,9 +87,14 @@ class PcaConfig(GenomicsConfig):
     # to randomized subspace iteration (the sharded-eig path).
     dense_eigh_limit: int = 8192
     # Shard-parallel host ingest workers (fused paths): 0 = auto (core
-    # count), 1 = serial. Results are bit-identical at any setting — the
-    # ordered map preserves manifest order into the accumulator.
+    # count capped at 16), 1 = serial. Results are bit-identical at any
+    # setting — the ordered map preserves manifest order into the
+    # accumulator.
     ingest_workers: int = 0
+    # Spark-style speculative execution for straggler shards: when the
+    # head-of-line extraction runs far past the median, a duplicate
+    # attempt races it and the winner's (identical) result is used.
+    speculative_ingest: bool = False
     # Fail-stop deadline (seconds) per pod collective phase: a lost peer
     # stalls survivors inside a native collective forever; the watchdog
     # turns that into a loud exit-77 + snapshot resume (utils/watchdog.py).
@@ -200,6 +205,16 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         "ingest; 0 = auto, one per core capped at 16 to bound peak memory; "
         "1 = serial). Results are bit-identical at any setting; only "
         "wall-clock changes",
+    )
+    p.add_argument(
+        "--speculative-ingest",
+        action="store_true",
+        help="Speculatively re-execute straggler shard extractions "
+        "(Spark speculation analog): when the head-of-line shard runs "
+        "far past the median completed duration, a duplicate attempt "
+        "races it on a spare thread and the first identical result "
+        "wins; a failed attempt defers to the survivor. Needs "
+        "--ingest-workers > 1 (or auto)",
     )
     p.add_argument(
         "--collective-timeout",

@@ -118,11 +118,16 @@ def save_lane(
 def _read_lane(path: str, run_digest: str, n: int) -> Optional[Lane]:
     try:
         # npz members decompress individually — digest/units/shape checks
-        # never pull the (N, N) payload into memory.
+        # never pull the (N, N) payload into memory. Lanes written before
+        # g_shape existed lack the member; fall back to decompressing the
+        # payload once rather than discarding a prior run's progress.
         with np.load(path) as z:
             if bytes(z["run_digest"]).decode() != run_digest:
                 return None
-            if tuple(z["g_shape"]) != (n, n):
+            shape = (
+                tuple(z["g_shape"]) if "g_shape" in z else z["g"].shape
+            )
+            if shape != (n, n):
                 return None
             return Lane(
                 path=path,

@@ -134,6 +134,45 @@ class TestLanes:
         assert not stale.exists()
         assert fresh.exists()  # could be a live peer's write — kept
 
+    def test_corrupt_payload_detected_lazily(self, tmp_path):
+        """Metadata reads fine, the compressed g member is corrupt: the
+        lane lists normally (lazy load) and only load_g raises."""
+        g = (
+            np.random.default_rng(0)
+            .random((64, 64))
+            .astype(np.float32)
+        )
+        path = elastic.save_lane(str(tmp_path), g, [0], "d")
+        data = bytearray(open(path, "rb").read())
+        i = data.find(b"g.npy")
+        assert i > 0
+        for off in range(i + 60, i + 90):
+            data[off] ^= 0xFF
+        open(path, "wb").write(bytes(data))
+        lanes = elastic.load_lanes(str(tmp_path), "d", 64)
+        assert len(lanes) == 1  # metadata members intact
+        with pytest.raises(Exception):
+            lanes[0].load_g()
+
+    def test_lane_without_g_shape_still_loads(self, tmp_path):
+        """Back-compat: lanes written before the g_shape member existed
+        must keep resuming (payload decompressed once as fallback)."""
+        import tempfile
+
+        g = np.ones((3, 3), np.float32)
+        fd, tmp = tempfile.mkstemp(dir=str(tmp_path), suffix=".npz.tmp")
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(
+                f,
+                g=g,
+                units=np.asarray([1], np.int64),
+                run_digest=np.bytes_(b"d"),
+            )
+        os.replace(tmp, str(tmp_path / "lane-oldformat.npz"))
+        lanes = elastic.load_lanes(str(tmp_path), "d", 3)
+        assert len(lanes) == 1 and lanes[0].units == frozenset({1})
+        np.testing.assert_array_equal(lanes[0].load_g(), g)
+
     def test_fingerprint_order_independent(self, tmp_path):
         g = np.zeros((2, 2))
         elastic.save_lane(str(tmp_path), g, [0], "d")
@@ -260,6 +299,33 @@ class TestElasticPipeline:
             if f.startswith("lane-")
         ]
         assert len(lane_files) == 1
+
+    def test_corrupt_claimed_lane_reexecuted(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        """A claimed lane whose payload fails to decompress is warned
+        about and its units re-executed — resume never dies on it."""
+        from zipfile import BadZipFile
+
+        conf = _conf(tmp_path)
+        VariantsPcaDriver(
+            conf, synthetic_cohort(12, 100)
+        ).get_similarity_matrix_checkpointed()
+
+        def boom(self):
+            raise BadZipFile("Bad CRC-32 for file 'g.npy'")
+
+        monkeypatch.setattr(elastic.Lane, "load_g", boom)
+        src = synthetic_cohort(12, 100)
+        g = np.asarray(
+            VariantsPcaDriver(
+                conf, src
+            ).get_similarity_matrix_checkpointed()
+        )
+        monkeypatch.undo()
+        assert src.stats.partitions == 5  # every unit re-ingested
+        np.testing.assert_array_equal(g, _plain_gramian())
+        assert "unreadable" in capsys.readouterr().err
 
     def test_full_driver_run_elastic(self, tmp_path):
         result = VariantsPcaDriver(

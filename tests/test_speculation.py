@@ -1,0 +1,197 @@
+"""Speculative execution for straggler shards — Spark speculation analog.
+
+Spark re-launches a task that runs far past its stage's median on another
+executor and takes the first finisher (the straggler half of the
+elasticity the reference inherits; SURVEY.md §2.10). Here the extraction
+unit is a shard, extraction is idempotent and deterministic, and the
+duplicate races on a spare thread — so the winner's identity can never
+change the output, only the wall-clock. These tests pin:
+
+- a wedged head-of-line item is speculated and the duplicate's result
+  unblocks the stream (order + values intact);
+- no speculation without opting in, and never before the median-based
+  eligibility threshold;
+- a failed attempt defers to its survivor (speculation doubles as retry
+  for stragglers that die slowly); both failing surfaces the error;
+- the driver wires --speculative-ingest through with identical results.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.genomics.fixtures import (
+    DEFAULT_VARIANT_SET_ID,
+    synthetic_cohort,
+)
+from spark_examples_tpu.models.pca import VariantsPcaDriver
+from spark_examples_tpu.utils import concurrency
+from spark_examples_tpu.utils.concurrency import ordered_parallel_map
+from spark_examples_tpu.utils.config import PcaConfig
+
+
+@pytest.fixture()
+def fast_thresholds(monkeypatch):
+    """Shrink the eligibility knobs so tests run in milliseconds."""
+    monkeypatch.setattr(concurrency, "SPECULATION_MIN_COMPLETED", 3)
+    monkeypatch.setattr(concurrency, "SPECULATION_FLOOR_SECONDS", 0.02)
+    monkeypatch.setattr(concurrency, "SPECULATION_MULTIPLIER", 3.0)
+
+
+class TestSpeculation:
+    def test_wedged_head_unblocked_by_duplicate(self, fast_thresholds):
+        """First attempt at item 7 wedges until released; the speculative
+        duplicate completes and the stream finishes correctly."""
+        release = threading.Event()
+        attempts = {}
+        lock = threading.Lock()
+        speculated = []
+
+        def fn(i):
+            with lock:
+                n = attempts[i] = attempts.get(i, 0) + 1
+            if i == 7 and n == 1:
+                release.wait(30)  # the wedge: far past any threshold
+            return i * i
+
+        out = []
+        for r in ordered_parallel_map(
+            fn,
+            range(12),
+            workers=4,
+            speculate=True,
+            on_speculate=speculated.append,
+        ):
+            out.append(r)
+            if r == 49:
+                # The duplicate won; release the wedged original so the
+                # pool can shut down promptly at stream end.
+                release.set()
+        assert out == [i * i for i in range(12)]
+        assert speculated == [7]
+        assert attempts[7] == 2  # exactly one duplicate
+
+    def test_no_speculation_when_disabled(self, fast_thresholds):
+        attempts = {}
+        lock = threading.Lock()
+
+        def fn(i):
+            with lock:
+                attempts[i] = attempts.get(i, 0) + 1
+            if i == 5:
+                time.sleep(0.4)  # slow but finite
+            return i
+
+        out = list(ordered_parallel_map(fn, range(10), workers=4))
+        assert out == list(range(10))
+        assert all(v == 1 for v in attempts.values())
+
+    def test_not_eligible_before_min_completed(self, monkeypatch):
+        """With the minimum sample count unmet, even a slow head is
+        never speculated."""
+        monkeypatch.setattr(concurrency, "SPECULATION_MIN_COMPLETED", 100)
+        speculated = []
+
+        def fn(i):
+            if i == 0:
+                time.sleep(0.3)
+            return i
+
+        out = list(
+            ordered_parallel_map(
+                fn,
+                range(8),
+                workers=4,
+                speculate=True,
+                on_speculate=speculated.append,
+            )
+        )
+        assert out == list(range(8))
+        assert speculated == []
+
+    def test_failed_original_defers_to_speculative_survivor(
+        self, fast_thresholds
+    ):
+        """The wedged original eventually dies; its duplicate's result is
+        used and no error surfaces."""
+        blow_up = threading.Event()
+        attempts = {}
+        lock = threading.Lock()
+
+        def fn(i):
+            with lock:
+                n = attempts[i] = attempts.get(i, 0) + 1
+            if i == 6 and n == 1:
+                blow_up.wait(30)
+                raise IOError("original died slowly")
+            return i + 100
+
+        speculated = []
+        results = []
+        for r in ordered_parallel_map(
+            fn,
+            range(10),
+            workers=4,
+            speculate=True,
+            on_speculate=speculated.append,
+        ):
+            results.append(r)
+            if r == 106:
+                blow_up.set()  # duplicate already won; let original die
+        assert results == [i + 100 for i in range(10)]
+        assert speculated == [6]
+
+    def test_both_attempts_failing_surfaces_error(self, fast_thresholds):
+        def fn(i):
+            if i == 4:
+                time.sleep(0.5)
+                raise IOError("shard is truly broken")
+            return i
+
+        with pytest.raises(IOError, match="truly broken"):
+            list(
+                ordered_parallel_map(
+                    fn, range(10), workers=4, speculate=True
+                )
+            )
+
+    def test_serial_path_ignores_speculation(self):
+        out = list(
+            ordered_parallel_map(
+                lambda i: i, range(5), workers=1, speculate=True
+            )
+        )
+        assert out == list(range(5))
+
+
+class TestDriverWiring:
+    def test_speculative_ingest_matches_plain(self, fast_thresholds):
+        """--speculative-ingest produces a bit-identical Gramian (the
+        duplicate's result IS the original's result)."""
+        conf = PcaConfig(
+            variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+            bases_per_partition=20_000,
+            block_variants=64,
+            ingest_workers=4,
+            speculative_ingest=True,
+        )
+        driver = VariantsPcaDriver(conf, synthetic_cohort(12, 100))
+        g = np.asarray(
+            driver.get_similarity_matrix(driver.get_calls_fused())
+        )
+
+        plain = VariantsPcaDriver(
+            PcaConfig(
+                variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+                bases_per_partition=20_000,
+                block_variants=64,
+            ),
+            synthetic_cohort(12, 100),
+        )
+        data = plain.get_data()
+        calls = plain.get_calls([plain.filter_dataset(d) for d in data])
+        np.testing.assert_array_equal(
+            g, np.asarray(plain.get_similarity_matrix(calls))
+        )
