@@ -47,6 +47,7 @@ else
 fi
 timeout 1800 python -m spark_examples_tpu.cli.main pca \
   $SRC_ARGS --references 20:1:63025520 \
+  --trace-dir "$OUT/chr20_trace" \
   --output-path "$OUT/chr20" >"$OUT/chr20_probe.txt" 2>&1
 echo "chr20 probe rc=$?" >&2
 
