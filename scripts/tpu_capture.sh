@@ -51,27 +51,10 @@ timeout 1800 python -m spark_examples_tpu.cli.main pca \
   --output-path "$OUT/chr20" >"$OUT/chr20_probe.txt" 2>&1
 echo "chr20 probe rc=$?" >&2
 
-# 4. Pallas numerical check on hardware (bit-exactness vs einsum).
-timeout 900 python - >"$OUT/pallas_exact.txt" 2>&1 <<'EOF'
-import numpy as np, jax, jax.numpy as jnp
-from spark_examples_tpu.ops.gramian import gramian
-from spark_examples_tpu.ops.pallas_gramian import (
-    BLOCK_N,
-    gramian_accumulate_pallas,
-    gramian_accumulate_pallas_sym,
-)
-from spark_examples_tpu.arrays.blocks import round_up_multiple
-n = round_up_multiple(1024, BLOCK_N)
-x = (np.random.default_rng(0).random((n, 2048)) < 0.1).astype(np.int8)
-want = np.asarray(gramian(x))
-xd = jax.device_put(x)
-for name, fn in (
-    ("dense", gramian_accumulate_pallas),
-    ("sym", gramian_accumulate_pallas_sym),
-):
-    got = np.asarray(fn(jnp.zeros((n, n), jnp.float32), xd))
-    print(name, "bit-exact:", np.array_equal(got, want))
-EOF
-echo "pallas exact rc=$?" >&2
+# 4. The hardware-gated suite: Pallas lowering + bit-exactness, int8/f32
+#    agreement, on-chip PCoA parity vs the MLlib-semantics reference.
+timeout 1200 python -m pytest tests_tpu/ -q \
+  >"$OUT/hardware_tests.txt" 2>&1
+echo "hardware tests rc=$?" >&2
 
 echo "capture complete: $(ls "$OUT")" >&2

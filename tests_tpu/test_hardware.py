@@ -1,0 +1,127 @@
+"""On-chip certifications: Pallas lowering/bit-exactness and PCoA parity.
+
+Every import of jax (and of modules that import it) stays inside test
+bodies/fixtures: at COLLECTION time nothing may initialize a backend,
+because on an axon machine with a dead relay that blocks forever (the
+conftest skips collection there via a plain TCP probe instead).
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def tpu():
+    import os
+
+    import jax
+
+    if jax.default_backend() not in ("tpu", "axon"):
+        pytest.skip("no TPU backend on this machine")
+    # Same persistent compilation cache as bench.py: first-time compiles
+    # through the relay take minutes, and a relay-liveness window may be
+    # short — a recompile lost to a mid-window death must not cost the
+    # harvest its certification every round.
+    from spark_examples_tpu.utils.compile_cache import compilation_cache_dir
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        compilation_cache_dir(
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                ".jax_cache",
+            )
+        ),
+    )
+    return jax
+
+
+def _random_blocks(n, v, density=0.1, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.random((n, v)) < density).astype(np.int8)
+
+
+class TestPallasOnHardware:
+    """The kernels have been interpret-mode-green for two rounds; this is
+    the part only hardware can certify — that they LOWER and match the
+    einsum path bit-for-bit on the chip (VariantsPca.scala:184-189 hot
+    loop analog)."""
+
+    def test_dense_kernel_bit_exact(self, tpu):
+        import jax.numpy as jnp
+
+        from spark_examples_tpu.arrays.blocks import round_up_multiple
+        from spark_examples_tpu.ops import gramian
+        from spark_examples_tpu.ops.pallas_gramian import (
+            BLOCK_N,
+            gramian_accumulate_pallas,
+        )
+
+        n = round_up_multiple(1024, BLOCK_N)
+        x = _random_blocks(n, 2048)
+        want = np.asarray(gramian(x))
+        got = np.asarray(
+            gramian_accumulate_pallas(
+                jnp.zeros((n, n), jnp.float32), tpu.device_put(x)
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_sym_kernel_bit_exact(self, tpu):
+        import jax.numpy as jnp
+
+        from spark_examples_tpu.arrays.blocks import round_up_multiple
+        from spark_examples_tpu.ops import gramian
+        from spark_examples_tpu.ops.pallas_gramian import (
+            BLOCK_N,
+            gramian_accumulate_pallas_sym,
+        )
+
+        n = round_up_multiple(1024, BLOCK_N)
+        x = _random_blocks(n, 2048, seed=1)
+        want = np.asarray(gramian(x))
+        got = np.asarray(
+            gramian_accumulate_pallas_sym(
+                jnp.zeros((n, n), jnp.float32), tpu.device_put(x)
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+class TestNumericsOnHardware:
+    def test_int8_and_f32_gramians_agree(self, tpu):
+        """Both dtype modes are exact for 0/1 data below 2^24; the chip's
+        integer-MXU path must agree with the f32 path bit-for-bit."""
+        import jax.numpy as jnp
+
+        from spark_examples_tpu.ops import gramian_blockwise
+
+        n, v = 512, 4096
+        blocks = [_random_blocks(n, v, seed=s) for s in (2, 3)]
+        f32 = np.asarray(gramian_blockwise(blocks, n))
+        i8 = np.asarray(
+            gramian_blockwise(
+                blocks, n, compute_dtype=jnp.int8, accum_dtype=jnp.int32
+            )
+        )
+        np.testing.assert_array_equal(f32, i8.astype(f32.dtype))
+
+    def test_pcoa_parity_vs_mllib_reference(self, tpu):
+        """The BASELINE parity bar (≤1e-4 vs MLlib semantics), certified
+        on the chip rather than the CPU stand-in."""
+        from spark_examples_tpu.ops import (
+            gramian_blockwise,
+            mllib_principal_components_reference,
+            pcoa,
+        )
+
+        n, v = 512, 8192
+        blocks = [_random_blocks(n, v, seed=7)]
+        g = gramian_blockwise(blocks, n)
+        coords = np.asarray(pcoa(g, 2)[0])
+        # Both paths sign-normalize deterministically, so coordinates
+        # compare directly (same idiom as the CPU parity tests).
+        want, _ = mllib_principal_components_reference(
+            np.asarray(g).astype(np.float64), 2
+        )
+        np.testing.assert_allclose(coords, want, atol=1e-4)
