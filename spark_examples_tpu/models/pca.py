@@ -927,6 +927,7 @@ class VariantsPcaDriver:
                 self.mesh,
                 dense_eigh_limit=self.conf.dense_eigh_limit,
                 timer=timer,
+                eig_tol=self.conf.eig_tol,
             )
             coords = np.asarray(coords)
         else:

@@ -183,6 +183,89 @@ class TestReviewRegressions:
         assert err < 1e-4, err
 
 
+class TestAdaptiveEig:
+    """Opt-in tol-based convergence for the randomized eig path."""
+
+    @staticmethod
+    def _structured_c(n=1024, v=8192, seed=0):
+        rng = np.random.default_rng(seed)
+        groups = rng.integers(0, 3, size=n)
+        af = rng.beta(0.4, 1.2, size=(3, v))
+        x = (rng.random((n, v)) < af[groups]).astype(np.int8)
+        return np.asarray(
+            double_center(np.asarray(gramian(x), np.float64))
+        ).astype(np.float32)
+
+    def test_tol_zero_bit_identical_to_fixed(self):
+        """With an unreachable tol and the cap a chunk multiple, the
+        adaptive path applies the exact same operation sequence as the
+        fixed sweep — bit-identical output."""
+        c = jnp.asarray(self._structured_c(n=256, v=2048))
+        fixed_v, fixed_w = topk_eig_randomized(c, 2, iters=20)
+        adapt_v, adapt_w = topk_eig_randomized(
+            c, 2, iters=20, tol=0.0, check_every=5
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fixed_v), np.asarray(adapt_v)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fixed_w), np.asarray(adapt_w)
+        )
+
+    def test_converges_early_and_meets_parity_bar(self):
+        """On a sharp population-structure spectrum the adaptive sweep
+        stops well before the cap and still clears the 1e-4 bar."""
+        from spark_examples_tpu.utils.tracing import StageTimer
+
+        c = self._structured_c()
+        exact_v, _ = principal_components(c.astype(np.float64), 2)
+        timer = StageTimer()
+        rand_v, _ = topk_eig_randomized(
+            jnp.asarray(c), 2, iters=60, tol=1e-6, timer=timer
+        )
+        err = np.abs(
+            np.abs(np.asarray(rand_v)) - np.abs(np.asarray(exact_v))
+        ).max()
+        assert err < 1e-4, err
+        note = [
+            n
+            for notes in timer.notes.values()
+            for n in notes
+            if "randomized eig" in n
+        ]
+        assert len(note) == 1
+        used = int(note[0].split(":")[1].split("/")[0])
+        assert used < 60  # converged before the cap
+
+    def test_sharded_pcoa_threads_eig_tol(self):
+        """eig_tol flows through sharded_pcoa's randomized branch.
+
+        Structured spectrum (population groups): the randomized path is
+        rotation-fragile on flat random spectra by design — the same
+        reason test_sharded_pcoa_randomized_path compares at 1e-2.
+        """
+        rng = np.random.default_rng(3)
+        groups = rng.integers(0, 3, size=96)
+        af = rng.beta(0.4, 1.2, size=(3, 2048))
+        x = (rng.random((96, 2048)) < af[groups]).astype(np.int8)
+        g = np.asarray(gramian(x), np.float32)
+        mesh = make_mesh()
+        exact, _ = sharded_pcoa(
+            jnp.asarray(g), 2, mesh, dense_eigh_limit=1024
+        )
+        approx, _ = sharded_pcoa(
+            jnp.asarray(g),
+            2,
+            mesh,
+            dense_eigh_limit=8,  # force the randomized branch
+            eig_tol=1e-7,
+        )
+        err = np.abs(
+            np.abs(np.asarray(approx)) - np.abs(np.asarray(exact))
+        ).max()
+        assert err < 1e-4, err
+
+
 def test_cli_pca_with_mesh_flag(capsys, tmp_path):
     from spark_examples_tpu.cli.main import main
 
