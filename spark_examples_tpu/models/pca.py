@@ -67,19 +67,15 @@ class VariantsPcaDriver:
             # Validate before any ingest work — failing in stage 5 would
             # waste the whole (potentially hours-long) Gramian pass.
             raise ValueError(f"--num-pc must be >= 1, got {conf.num_pc}")
-        if conf.elastic_checkpoint:
+        if conf.elastic_checkpoint and not conf.checkpoint_dir:
             # A checkpoint flag that silently does nothing loses the user
             # hours of presumed-checkpointed work — refuse up front.
-            if not conf.checkpoint_dir:
-                raise ValueError(
-                    "--elastic-checkpoint requires --checkpoint-dir"
-                )
-            if len(conf.variant_set_ids) != 1:
-                raise ValueError(
-                    "--elastic-checkpoint supports a single variantset "
-                    "(checkpointed ingest cannot cut the N-way identity "
-                    "merge at shard boundaries)"
-                )
+            # (Multi-dataset preconditions — fused keyed source, unique
+            # contig runs — are validated in _checkpointed_elastic,
+            # still before any ingest.)
+            raise ValueError(
+                "--elastic-checkpoint requires --checkpoint-dir"
+            )
         self.conf = conf
         self.source = source
         self.mesh = mesh
@@ -261,10 +257,7 @@ class VariantsPcaDriver:
         """Fused multi-dataset ingest: keyed triples per dataset →
         identity join/merge, same observable behavior as the staged path
         (parity-tested), without Call/Variant materialization."""
-        from spark_examples_tpu.genomics.datasets import calls_stream_keyed
-
         shards = self._manifest()
-        unique = _contig_runs_unique(shards)
         if self.conf.min_allele_frequency is not None:
             for _ in self.conf.variant_set_ids:
                 # One parity print per dataset (filter_dataset prints per
@@ -273,6 +266,14 @@ class VariantsPcaDriver:
                     f"Min allele frequency "
                     f"{self.conf.min_allele_frequency}."
                 )
+        return self._keyed_calls(shards, _contig_runs_unique(shards))
+
+    def _keyed_calls(self, shards, contig_runs_unique: bool):
+        """The ONE keyed multi-dataset ingest recipe (worker-budget
+        split + keyed streams + identity join/merge), shared by the full
+        fused path and the elastic per-unit path so the two can never
+        diverge."""
+        from spark_examples_tpu.genomics.datasets import calls_stream_keyed
 
         # One worker pool per dataset stream runs concurrently under
         # calls_stream_keyed — split the budget so K datasets never
@@ -292,7 +293,7 @@ class VariantsPcaDriver:
 
         return calls_stream_keyed(
             [keyed(v) for v in self.conf.variant_set_ids],
-            contig_runs_unique=unique,
+            contig_runs_unique=contig_runs_unique,
         )
 
     @staticmethod
@@ -424,11 +425,13 @@ class VariantsPcaDriver:
         )
         from spark_examples_tpu.genomics.shards import manifest_digest
 
+        if self.conf.elastic_checkpoint:
+            # Elastic supports multi-dataset joins via contig-aligned
+            # units; the grid-keyed modes below stay single-set.
+            return self._checkpointed_elastic()
         assert len(self.conf.variant_set_ids) == 1, (
             "checkpointed ingest supports a single variantset"
         )
-        if self.conf.elastic_checkpoint:
-            return self._checkpointed_elastic()
         if self._mesh_spans_processes():
             return self._checkpointed_pod()
         vsid = self.conf.variant_set_ids[0]
@@ -506,12 +509,42 @@ class VariantsPcaDriver:
                 "accumulation regime; a process-spanning mesh needs the "
                 "fixed-grid pod checkpointing (omit --elastic-checkpoint)"
             )
-        vsid = self.conf.variant_set_ids[0]
+        vsids = self.conf.variant_set_ids
+        multi = len(vsids) > 1
         shards_all = self._global_manifest()
         every = max(1, self.conf.checkpoint_every)
+        if multi:
+            # Multi-dataset joins checkpoint EXACTLY when work units
+            # never split a contig: the identity join/merge keeps
+            # per-contig state (identities hash contig+position+alleles),
+            # so whole-contig units reproduce the uninterrupted join
+            # row-for-row. The reference's only join resume was the
+            # all-or-nothing objectFile (VariantsCommon.scala:52-55).
+            if not self._fused_multi_possible():
+                raise ValueError(
+                    "elastic multi-dataset checkpointing needs the fused "
+                    "keyed ingest (a source with stream_carrying_keyed, "
+                    "no --debug-datasets)"
+                )
+            if not _contig_runs_unique(shards_all):
+                raise ValueError(
+                    "elastic multi-dataset checkpointing requires each "
+                    "contig to appear as one contiguous manifest run "
+                    "(join state is per-contig; units cut at contig "
+                    "boundaries)"
+                )
+        # Single-set keeps the bare id (digest back-compat with existing
+        # lanes); multi-set uses length-prefixed encoding so distinct id
+        # lists can never collide (['a','b+c'] vs ['a+b','c']).
+        vs_key = (
+            vsids[0]
+            if not multi
+            else ",".join(f"{len(v)}:{v}" for v in vsids)
+        )
         digest = (
-            f"{manifest_digest(shards_all)}|{vsid}"
+            f"{manifest_digest(shards_all)}|{vs_key}"
             f"|af={self.conf.min_allele_frequency}|every={every}|elastic"
+            + ("|contig-units" if multi else "")
         )
         n = self.index.size
         directory = os.path.join(self.conf.checkpoint_dir, "elastic")
@@ -585,7 +618,10 @@ class VariantsPcaDriver:
             # (safe: every host finished reading lanes at the agreement
             # barrier above; single-process runs have no reader to race).
             elastic.prune_stale_lanes(directory, digest, lanes)
-        units = elastic.unit_ranges(len(shards_all), every)
+        if multi:
+            units = elastic.unit_ranges_contig_aligned(shards_all, every)
+        else:
+            units = elastic.unit_ranges(len(shards_all), every)
         done = set()
         for lane in lanes:
             done |= lane.units
@@ -632,11 +668,24 @@ class VariantsPcaDriver:
                 g = lane_g
             else:
                 g += lane_g
+        if multi and my_units and self.conf.min_allele_frequency is not None:
+            for _ in vsids:  # one parity print per dataset stream
+                print(
+                    f"Min allele frequency "
+                    f"{self.conf.min_allele_frequency}."
+                )
         for u in my_units:
             lo, hi = units[u]
-            g = np.asarray(
-                self._ingest_shard_group(vsid, shards_all[lo:hi], g)
-            )
+            if multi:
+                g = np.asarray(
+                    self._ingest_unit_multi(shards_all[lo:hi], g)
+                )
+            else:
+                g = np.asarray(
+                    self._ingest_shard_group(
+                        vsids[0], shards_all[lo:hi], g
+                    )
+                )
             covered.add(u)
             own_paths = [
                 elastic.merge_and_supersede(
@@ -845,6 +894,19 @@ class VariantsPcaDriver:
         )
         return rounds_done, g
 
+    def _ingest_unit_multi(self, group, g):
+        """One contig-aligned unit through the fused keyed join → blocks
+        accumulated onto g (elastic multi-dataset checkpointing). The
+        group holds whole contigs, so the per-contig join state is
+        complete within the unit and the joined rows are identical to
+        the same contigs' slice of an uninterrupted run."""
+        blocks = blocks_from_calls(
+            self._keyed_calls(group, contig_runs_unique=True),
+            self.index.size,
+            self.conf.block_variants,
+        )
+        return self._blocks_to_gramian(blocks, g_init=g)
+
     def _ingest_shard_group(self, vsid: str, group, g):
         """Stream one shard group through filter → calls → Gramian blocks,
         accumulating onto g (shared by both checkpointed ingest modes)."""
@@ -1013,9 +1075,9 @@ class VariantsPcaDriver:
         timer = StageTimer()
         with profiler_trace(self.conf.trace_dir):
             with timer.stage("ingest+gramian"):
-                if (
-                    self.conf.checkpoint_dir
-                    and len(self.conf.variant_set_ids) == 1
+                if self.conf.checkpoint_dir and (
+                    len(self.conf.variant_set_ids) == 1
+                    or self.conf.elastic_checkpoint
                 ):
                     g = self.get_similarity_matrix_checkpointed()
                 elif self._fused_ingest_possible():

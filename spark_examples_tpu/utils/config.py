@@ -86,10 +86,11 @@ class PcaConfig(GenomicsConfig):
     # N above which the PCoA eigendecomposition switches from dense eigh
     # to randomized subspace iteration (the sharded-eig path).
     dense_eigh_limit: int = 8192
-    # Opt-in adaptive convergence for the randomized eig: stop once the
-    # top-k Ritz values' relative change per check-chunk drops below this
-    # (None = the fixed 30-iteration sweep). Cuts O(N²) matmuls ~2-3× on
-    # sharp spectra — pure chip time at stress N.
+    # Opt-in adaptive convergence for the randomized eig: stop once every
+    # top-k Ritz pair's relative residual ‖C·v − λ·v‖/|λ| drops below
+    # this (None = the fixed 30-iteration sweep); eigenvector error is
+    # then O(tol/gap). Cuts O(N²) matmuls ~2-3× on sharp spectra — pure
+    # chip time at stress N.
     eig_tol: Optional[float] = None
     # Shard-parallel host ingest workers (fused paths): 0 = auto (core
     # count capped at 16), 1 = serial. Results are bit-identical at any
@@ -261,8 +262,9 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         type=float,
         default=None,
         help="Adaptive convergence for the randomized eig path: stop "
-        "iterating once the top-k Ritz values' relative change drops "
-        "below this (default: fixed 30-iteration sweep). Cuts device "
+        "iterating once every top-k eigenpair's relative residual "
+        "|Cv - lv|/|l| drops below this (default: fixed 30-iteration "
+        "sweep); eigenvector error is then O(tol/gap). Cuts device "
         "matmuls ~2-3x on sharp spectra; the iteration count used "
         "appears in the stage report",
     )

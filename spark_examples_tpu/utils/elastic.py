@@ -88,6 +88,43 @@ def unit_ranges(n_shards: int, every: int) -> List[Tuple[int, int]]:
     ]
 
 
+def unit_ranges_contig_aligned(shards, every: int) -> List[Tuple[int, int]]:
+    """Work units that never split a contig's manifest run.
+
+    Multi-dataset identity joins keep per-contig state (the variant
+    identity hashes contig+position+alleles, so matches can only occur
+    within one contig): cutting work units at contig boundaries makes an
+    incrementally-checkpointed join EXACT — each unit's joined rows equal
+    the same contigs' rows in an uninterrupted run. Consecutive whole
+    runs pack into units of at most ``every`` shards; a single contig
+    longer than ``every`` becomes one oversized unit (it cannot be split
+    without breaking join-state locality).
+
+    Precondition (caller-verified): each contig appears as ONE contiguous
+    run in the manifest.
+    """
+    every = max(1, every)
+    runs: List[Tuple[int, int]] = []
+    lo = 0
+    for i in range(1, len(shards) + 1):
+        if i == len(shards) or shards[i].contig != shards[lo].contig:
+            runs.append((lo, i))
+            lo = i
+    units: List[Tuple[int, int]] = []
+    cur: Optional[List[int]] = None
+    for lo, hi in runs:
+        if cur is None:
+            cur = [lo, hi]
+        elif hi - cur[0] <= every:
+            cur[1] = hi
+        else:
+            units.append((cur[0], cur[1]))
+            cur = [lo, hi]
+    if cur is not None:
+        units.append((cur[0], cur[1]))
+    return units
+
+
 def save_lane(
     directory: str,
     g,

@@ -219,14 +219,31 @@ class TestElasticValidation:
         with pytest.raises(ValueError, match="--checkpoint-dir"):
             VariantsPcaDriver(conf, synthetic_cohort(4, 10))
 
-    def test_requires_single_variantset(self, tmp_path):
+    def test_multi_dataset_needs_keyed_source(self, tmp_path):
+        """Multi-dataset elastic requires the fused keyed ingest; a
+        source without stream_carrying_keyed errors before any work."""
+
+        class Bare:
+            def __init__(self, inner):
+                self._inner = inner
+                self.stats = inner.stats
+
+            def list_callsets(self, vsid):
+                return self._inner.list_callsets(vsid)
+
+            def stream_variants(self, vsid, shard):
+                return self._inner.stream_variants(vsid, shard)
+
         conf = PcaConfig(
-            variant_set_ids=["a", "b"],
+            variant_set_ids=[DEFAULT_VARIANT_SET_ID, "other"],
             checkpoint_dir=str(tmp_path),
             elastic_checkpoint=True,
         )
-        with pytest.raises(ValueError, match="single variantset"):
-            VariantsPcaDriver(conf, synthetic_cohort(4, 10))
+        driver = VariantsPcaDriver(
+            conf, Bare(synthetic_cohort(4, 10))
+        )
+        with pytest.raises(ValueError, match="stream_carrying_keyed"):
+            driver.get_similarity_matrix_checkpointed()
 
 
 class TestElasticPipeline:
@@ -484,6 +501,130 @@ def test_elastic_shrink_world_resume(tmp_path):
     calls = plain.get_calls([plain.filter_dataset(d) for d in data])
     g_plain = np.asarray(plain.get_similarity_matrix(calls))
     np.testing.assert_array_equal(g, g_plain)
+
+
+class TestContigAlignedUnits:
+    class _S:
+        def __init__(self, contig):
+            self.contig = contig
+
+    def _shards(self, *contigs):
+        return [self._S(c) for c in contigs]
+
+    def test_packs_runs_up_to_every(self):
+        s = self._shards("1", "1", "2", "2", "3")
+        assert elastic.unit_ranges_contig_aligned(s, 4) == [(0, 4), (4, 5)]
+
+    def test_never_splits_a_run(self):
+        s = self._shards("1", "1", "1", "2")
+        # Contig 1's run (3 shards) exceeds every=2: one oversized unit.
+        assert elastic.unit_ranges_contig_aligned(s, 2) == [(0, 3), (3, 4)]
+
+    def test_single_contig_single_unit(self):
+        s = self._shards("17", "17", "17", "17", "17")
+        assert elastic.unit_ranges_contig_aligned(s, 2) == [(0, 5)]
+
+    def test_empty(self):
+        assert elastic.unit_ranges_contig_aligned([], 2) == []
+
+
+class TestElasticMultiDataset:
+    """Elastic checkpointing of multi-dataset JOINS via contig-aligned
+    units — the reference's only join resume was the all-or-nothing
+    objectFile (VariantsCommon.scala:52-55)."""
+
+    REFS = "17:41196311:41236311,20:100000:140000"  # 2 contigs, 4 shards
+
+    def _merged(self):
+        from spark_examples_tpu.genomics.sources import FixtureSource
+
+        a = synthetic_cohort(
+            8, 60, references=self.REFS, variant_set_id="setA", seed=1
+        )
+        b = synthetic_cohort(
+            8, 60, references=self.REFS, variant_set_id="setB", seed=1
+        )
+        return FixtureSource(
+            variants=a._variants + b._variants,
+            callsets=a._callsets + b._callsets,
+        )
+
+    def _conf(self, tmp_path, **kw):
+        kw.setdefault("references", self.REFS)
+        kw.setdefault("variant_set_ids", ["setA", "setB"])
+        return _conf(tmp_path, **kw)
+
+    def _plain_join_gramian(self):
+        driver = VariantsPcaDriver(
+            PcaConfig(
+                variant_set_ids=["setA", "setB"],
+                references=self.REFS,
+                bases_per_partition=20_000,
+                block_variants=64,
+            ),
+            self._merged(),
+        )
+        return np.asarray(
+            driver.get_similarity_matrix(driver.get_calls_fused_multi())
+        )
+
+    def test_matches_plain_join(self, tmp_path):
+        conf = self._conf(tmp_path)
+        g = np.asarray(
+            VariantsPcaDriver(
+                conf, self._merged()
+            ).get_similarity_matrix_checkpointed()
+        )
+        np.testing.assert_array_equal(g, self._plain_join_gramian())
+
+    def test_crash_and_resume_bit_equal(self, tmp_path):
+        conf = self._conf(tmp_path)
+        shards = conf.shards()
+        assert len(shards) == 4  # 2 runs of 2 → units (0,2) and (2,4)
+        src = self._merged()
+        src._fail_once.add(shards[2])  # first shard of unit 1
+        with pytest.raises(IOError):
+            VariantsPcaDriver(
+                conf, src
+            ).get_similarity_matrix_checkpointed()
+
+        src2 = self._merged()
+        g = np.asarray(
+            VariantsPcaDriver(
+                conf, src2
+            ).get_similarity_matrix_checkpointed()
+        )
+        # Unit 0 (contig 17) was banked; only contig 20's unit re-runs —
+        # 2 shards × 2 dataset streams.
+        assert src2.stats.partitions == 4
+        np.testing.assert_array_equal(g, self._plain_join_gramian())
+
+    def test_resume_skips_everything_when_done(self, tmp_path):
+        conf = self._conf(tmp_path)
+        VariantsPcaDriver(
+            conf, self._merged()
+        ).get_similarity_matrix_checkpointed()
+        src = self._merged()
+        VariantsPcaDriver(conf, src).get_similarity_matrix_checkpointed()
+        assert src.stats.partitions == 0
+
+    def test_nonunique_contig_runs_rejected(self, tmp_path):
+        conf = self._conf(
+            tmp_path,
+            references="17:41196311:41216311,20:100000:120000,"
+            "17:41216311:41236311",  # contig 17 appears as two runs
+        )
+        with pytest.raises(ValueError, match="contiguous manifest run"):
+            VariantsPcaDriver(
+                conf, self._merged()
+            ).get_similarity_matrix_checkpointed()
+
+    def test_full_driver_run(self, tmp_path):
+        result = VariantsPcaDriver(
+            self._conf(tmp_path), self._merged()
+        ).run()
+        assert len(result) == 16
+        assert {r[0].split("-")[0] for r in result} == {"setA", "setB"}
 
 
 class TestElasticOverNetwork:
