@@ -183,6 +183,98 @@ class TestLanes:
         ) == elastic.lane_view_fingerprint(list(reversed(lanes)))
 
 
+class TestLaneProtocolProperty:
+    """Hypothesis torture of the lane crash-safety invariant: under ANY
+    interleaving of per-process unit completions, merges, and crashes
+    (a crash = the supersede deletions are arbitrarily partially
+    applied), the surviving lanes are pairwise disjoint, every lane's
+    payload matches its declared unit set exactly, and completing the
+    uncovered units always reconstructs the full-work Gramian — no unit
+    lost, none double-counted."""
+
+    def test_random_crash_interleavings(self, tmp_path):
+        hypothesis = pytest.importorskip("hypothesis")
+        from hypothesis import given, settings, strategies as st
+
+        n_units = 6
+
+        def unit_vec(u):
+            g = np.zeros((n_units, 1), np.float32)
+            g[u, 0] = 1.0
+            return g
+
+        # A scenario: per process, an assignment of units and a crash
+        # point (how many of its units it completed, and whether its
+        # final merge's deletions were applied fully/partially/not).
+        proc = st.tuples(
+            st.integers(0, n_units),  # units completed by this process
+            st.integers(0, 2),  # 0=deletions done, 1=partial, 2=none
+        )
+        scenarios = st.lists(proc, min_size=1, max_size=3)
+
+        @settings(max_examples=40, deadline=None)
+        @given(scenarios=scenarios, data=st.data())
+        def run(scenarios, data):
+            import shutil
+            import tempfile
+
+            d = tempfile.mkdtemp(dir=str(tmp_path))
+            try:
+                # Deal units round-robin to processes.
+                world = len(scenarios)
+                for p, (completed, crash_mode) in enumerate(scenarios):
+                    mine = list(range(n_units))[p::world]
+                    covered = []
+                    g = np.zeros((n_units, 1), np.float32)
+                    own = []
+                    for u in mine[: min(completed, len(mine))]:
+                        covered.append(u)
+                        g = g + unit_vec(u)
+                        new = elastic.save_lane(d, g, covered, "dig")
+                        # Crash-window modeling: deletions of superseded
+                        # lanes applied fully, partially, or not at all.
+                        if crash_mode == 0:
+                            for old in own:
+                                os.remove(old)
+                            own = [new]
+                        elif crash_mode == 1 and own:
+                            keep = data.draw(
+                                st.integers(0, len(own) - 1)
+                            )
+                            for i, old in enumerate(own):
+                                if i != keep:
+                                    os.remove(old)
+                            own = [own[keep], new]
+                        else:
+                            own = own + [new]
+
+                lanes = elastic.load_lanes(d, "dig", n_units)
+                seen = set()
+                total = np.zeros((n_units, 1), np.float32)
+                for lane in lanes:
+                    assert lane.units.isdisjoint(seen)  # never double
+                    seen |= lane.units
+                    payload = lane.load_g()
+                    # Payload must be EXACTLY the sum of its declared
+                    # units' contributions.
+                    want = np.zeros((n_units, 1), np.float32)
+                    for u in lane.units:
+                        want += unit_vec(u)
+                    np.testing.assert_array_equal(payload, want)
+                    total += payload
+                # Completing the uncovered units reconstructs all work.
+                for u in range(n_units):
+                    if u not in seen:
+                        total += unit_vec(u)
+                np.testing.assert_array_equal(
+                    total, np.ones((n_units, 1), np.float32)
+                )
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+
+        run()
+
+
 def _conf(tmp_path, **kw):
     base = dict(
         variant_set_ids=[DEFAULT_VARIANT_SET_ID],
