@@ -78,15 +78,12 @@ def tpu_time(blocks, cpu_fallback=False):
     # first time; cached thereafter. The dir is keyed by host CPU features
     # so a cache populated on a different host can't feed this one illegal
     # instructions (see utils/compile_cache.py).
-    from spark_examples_tpu.utils.compile_cache import compilation_cache_dir
+    from spark_examples_tpu.utils.compile_cache import (
+        enable_persistent_cache,
+    )
 
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        compilation_cache_dir(
-            os.path.join(
-                os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
-            )
-        ),
+    enable_persistent_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
     )
     from spark_examples_tpu.ops import gramian_blockwise, pcoa
 

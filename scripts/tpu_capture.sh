@@ -11,6 +11,9 @@
 
 set -u
 cd "$(dirname "$0")/.."
+# `python scripts/foo.py` puts scripts/ on sys.path, NOT the repo root —
+# without this the probes cannot import the package at all.
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 OUT="${1:-/tmp/tpu_capture}"
 mkdir -p "$OUT"
 

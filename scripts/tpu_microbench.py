@@ -10,8 +10,15 @@ Usage (needs the TPU relay alive):
 """
 
 import argparse
+import os
 import sys
 import time
+
+# Robust when invoked as `python scripts/tpu_microbench.py`: the script
+# dir lands on sys.path, the repo root (the package) does not.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 import numpy as np
 

@@ -18,8 +18,15 @@ number would pollute the evidence.
 """
 
 import json
+import os
 import sys
 import time
+
+# Robust when invoked as `python scripts/tpu_quick_probe.py`: the script
+# dir lands on sys.path, the repo root (the package) does not.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 
 def emit(**kw):
@@ -27,21 +34,18 @@ def emit(**kw):
 
 
 def main():
-    import os
+    from spark_examples_tpu.utils.compile_cache import (
+        enable_persistent_cache,
+    )
 
-    from spark_examples_tpu.utils.compile_cache import compilation_cache_dir
+    enable_persistent_cache(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        )
+    )
 
     import jax
-
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        compilation_cache_dir(
-            os.path.join(
-                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                ".jax_cache",
-            )
-        ),
-    )
     import jax.numpy as jnp
     import numpy as np
 

@@ -478,6 +478,57 @@ class VariantsPcaDriver:
             g = allreduce_gramian(jax.numpy.asarray(g))
         return g
 
+    def _elastic_shared_dir_probe(self, directory, p, world):
+        """Verify every host sees ONE checkpoint dir, before any work.
+
+        Write-probe rather than lane fingerprints: on a first run every
+        host sees zero lanes, so fingerprints cannot distinguish a shared
+        dir from per-host local disks — and discovering that only after a
+        crash strands each host's lanes. Every process drops a token,
+        barriers, then must see every peer's token. Miss counts are
+        exchanged BEFORE tokens are deleted (allgather syncs entry, not
+        exit — deleting first lets a fast host remove its token before a
+        slow host checks), and EVERY host fails when ANY host missed: a
+        one-sided raise would strand the passing hosts in the next
+        collective.
+        """
+        from jax.experimental import multihost_utils
+
+        os.makedirs(directory, exist_ok=True)
+        token = os.path.join(directory, f".probe-{p}")
+        with open(token, "w") as f:
+            f.write(str(p))
+        with self._watchdog().armed("elastic shared-dir probe"):
+            multihost_utils.process_allgather(np.array([p], np.int64))
+        missing = [
+            i
+            for i in range(world)
+            if not os.path.exists(os.path.join(directory, f".probe-{i}"))
+        ]
+        with self._watchdog().armed("elastic shared-dir probe (exit)"):
+            misses = np.asarray(
+                multihost_utils.process_allgather(
+                    np.array([len(missing)], np.int64)
+                )
+            ).ravel()
+        try:
+            os.remove(token)
+        except OSError:
+            pass
+        if int(misses.max()) > 0:
+            detail = (
+                f"this host cannot see the probe file(s) of "
+                f"process(es) {missing}; "
+                if missing
+                else ""
+            )
+            raise RuntimeError(
+                "elastic multi-host checkpointing requires "
+                "--checkpoint-dir on a filesystem every host shares; "
+                f"{detail}probe miss counts per process: "
+                f"{misses.tolist()}"
+            )
+
     def _checkpointed_elastic(self):
         """Elastic ingest: Spark-task-style work units, any-world-size resume.
 
@@ -550,54 +601,7 @@ class VariantsPcaDriver:
         directory = os.path.join(self.conf.checkpoint_dir, "elastic")
         p, world = jax.process_index(), jax.process_count()
         if world > 1:
-            # Write-probe FIRST: on a first run every host sees zero lanes,
-            # so a lane fingerprint alone cannot distinguish a shared dir
-            # from per-host local disks — and discovering that only after
-            # a crash strands each host's lanes. Every process drops a
-            # token, barriers, then must see every peer's token.
-            os.makedirs(directory, exist_ok=True)
-            token = os.path.join(directory, f".probe-{p}")
-            with open(token, "w") as f:
-                f.write(str(p))
-            with self._watchdog().armed("elastic shared-dir probe"):
-                multihost_utils.process_allgather(
-                    np.array([p], np.int64)
-                )
-            missing = [
-                i
-                for i in range(world)
-                if not os.path.exists(
-                    os.path.join(directory, f".probe-{i}")
-                )
-            ]
-            # Exchange miss counts BEFORE deleting tokens (allgather syncs
-            # entry, not exit — deleting first lets a fast host remove its
-            # token before a slow host checks) and fail on EVERY host when
-            # ANY host missed: a one-sided raise would strand the passing
-            # hosts in the next collective.
-            with self._watchdog().armed("elastic shared-dir probe (exit)"):
-                misses = np.asarray(
-                    multihost_utils.process_allgather(
-                        np.array([len(missing)], np.int64)
-                    )
-                ).ravel()
-            try:
-                os.remove(token)
-            except OSError:
-                pass
-            if int(misses.max()) > 0:
-                detail = (
-                    f"this host cannot see the probe file(s) of "
-                    f"process(es) {missing}; "
-                    if missing
-                    else ""
-                )
-                raise RuntimeError(
-                    "elastic multi-host checkpointing requires "
-                    "--checkpoint-dir on a filesystem every host shares; "
-                    f"{detail}probe miss counts per process: "
-                    f"{misses.tolist()}"
-                )
+            self._elastic_shared_dir_probe(directory, p, world)
         lanes = elastic.load_lanes(directory, digest, n)
         if world > 1:
             fp = bytes.fromhex(elastic.lane_view_fingerprint(lanes))

@@ -21,7 +21,11 @@ import hashlib
 import os
 import platform
 
-__all__ = ["host_feature_key", "compilation_cache_dir"]
+__all__ = [
+    "host_feature_key",
+    "compilation_cache_dir",
+    "enable_persistent_cache",
+]
 
 
 def host_feature_key() -> str:
@@ -45,4 +49,22 @@ def compilation_cache_dir(base: str) -> str:
     """Per-host-feature-set subdirectory of ``base`` (created if missing)."""
     path = os.path.join(base, f"host-{host_feature_key()}")
     os.makedirs(path, exist_ok=True)
+    return path
+
+
+def enable_persistent_cache(base: str) -> str:
+    """Point jax at the host-keyed cache under ``base``; → the dir used.
+
+    One call shared by every measurement entry point (bench.py, the TPU
+    quick probe, the hardware-gated test suite): first-time compiles
+    through the axon tunnel take minutes, and a relay-liveness window may
+    be short — no harvest step should spend it recompiling another's
+    programs. ``base`` is required and callers anchor it to their OWN
+    file location (the checkout) — deriving a default from this module's
+    path would point a non-editable install at site-packages.
+    """
+    import jax
+
+    path = compilation_cache_dir(base)
+    jax.config.update("jax_compilation_cache_dir", path)
     return path

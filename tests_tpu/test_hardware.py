@@ -12,26 +12,23 @@ import pytest
 
 @pytest.fixture(scope="session")
 def tpu():
-    import os
-
     import jax
 
     if jax.default_backend() not in ("tpu", "axon"):
         pytest.skip("no TPU backend on this machine")
-    # Same persistent compilation cache as bench.py: first-time compiles
-    # through the relay take minutes, and a relay-liveness window may be
-    # short — a recompile lost to a mid-window death must not cost the
-    # harvest its certification every round.
-    from spark_examples_tpu.utils.compile_cache import compilation_cache_dir
+    # Same persistent compilation cache as bench.py: a relay-liveness
+    # window may be short and must not be spent recompiling.
+    import os
 
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        compilation_cache_dir(
-            os.path.join(
-                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                ".jax_cache",
-            )
-        ),
+    from spark_examples_tpu.utils.compile_cache import (
+        enable_persistent_cache,
+    )
+
+    enable_persistent_cache(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        )
     )
     return jax
 
