@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import urllib.error
 import urllib.request
@@ -37,6 +38,10 @@ from urllib.parse import parse_qs, urlencode, urlparse
 from spark_examples_tpu.genomics.auth import Credentials
 from spark_examples_tpu.genomics.shards import Shard
 from spark_examples_tpu.genomics.sources import (
+    MIRROR_COMPLETE_MARKER,
+    MIRROR_IDENTITY_FILE,
+    MIRROR_SIDECAR_OK,
+    SIDECAR_BASENAME,
     Callset,
     _read_to_record,
     _variant_to_record,
@@ -240,6 +245,35 @@ def _make_handler(source, token: Optional[str]):
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif url.path == "/export-sidecar":
+                    # Binary CSR sidecar export: the client mirrors this
+                    # file to skip its own cold parse (at all-autosomes
+                    # scale, a ~2.7 GB npz in place of a ~58 GB JSONL
+                    # parse). Raw bytes with Content-Length — npz is
+                    # already compressed, and the length lets the client
+                    # detect truncation.
+                    ensure = getattr(source, "ensure_sidecar", None)
+                    path = ensure() if ensure is not None else None
+                    if not path:
+                        self.send_error(
+                            404, "source has no sidecar to export"
+                        )
+                        return
+                    # Open BEFORE stat: a concurrent rebuild os.replace()s
+                    # the file, and a header length taken from a different
+                    # inode than the streamed body corrupts the download.
+                    with open(path, "rb") as f:
+                        size = os.fstat(f.fileno()).st_size
+                        self.send_response(200)
+                        self.send_header("Content-Length", str(size))
+                        self.end_headers()
+                        remaining = size
+                        while remaining > 0:
+                            chunk = f.read(min(1 << 20, remaining))
+                            if not chunk:
+                                break
+                            self.wfile.write(chunk)
+                            remaining -= len(chunk)
                 elif url.path.startswith("/export/"):
                     # Whole-cohort interchange-file export, framed and
                     # gzip-able like every stream: the bulk path remote
@@ -398,18 +432,26 @@ class HttpVariantSource:
                 return False
             raise
         root = os.path.join(self._cache_dir, f"cohort-{ident}")
-        if not os.path.exists(os.path.join(root, ".complete")):
-            self._download_mirror(root)
+        if not os.path.exists(os.path.join(root, MIRROR_COMPLETE_MARKER)):
+            self._download_mirror(root, ident)
         from spark_examples_tpu.genomics.sources import JsonlSource
 
         return JsonlSource(root, stats=self.stats)
 
-    def _download_mirror(self, root: str) -> None:
+    def _download_mirror(self, root: str, ident: str) -> None:
         """Atomically populate ``root`` with the served cohort's
         interchange files: download into a temp dir, mark complete,
         rename. A crash mid-download leaves only a temp dir that can
         never be mistaken for a mirror; a populate race is resolved by
-        whichever process renames first (identical content by identity)."""
+        whichever process renames first (identical content by identity).
+
+        When the server exports its binary CSR sidecar, it ships too —
+        the mirror's first fused access then skips the cold parse
+        entirely. The sidecar can never match the mirror's file stats
+        (fresh mtimes; possibly decompressed sizes), so the
+        ``.identity``/``.sidecar-ok`` pair records that the MIRROR
+        PROTOCOL vouches for it (see _CsrCohort._mirror_sidecar_trusted).
+        """
         import shutil
         import tempfile
 
@@ -431,14 +473,60 @@ class HttpVariantSource:
                     ):
                         out.write(line)
                         out.write(b"\n")
-            open(os.path.join(tmp, ".complete"), "w").close()
+            with open(os.path.join(tmp, MIRROR_IDENTITY_FILE), "w") as f:
+                f.write(ident)
+            try:
+                resp = self._request("/export-sidecar", {})
+                # Content-Length is enforced by http.client: a premature
+                # EOF raises (IncompleteRead) instead of leaving a
+                # silently truncated npz; even then, an unreadable file
+                # just falls back to a local rebuild.
+                with resp, open(
+                    os.path.join(tmp, SIDECAR_BASENAME), "wb"
+                ) as out:
+                    shutil.copyfileobj(resp, out)
+                with open(
+                    os.path.join(tmp, MIRROR_SIDECAR_OK), "w"
+                ) as f:
+                    f.write(ident)
+            except (IOError, OSError) as e:
+                # The sidecar is a pure optimization; its failure must
+                # never destroy the mandatory JSONL mirror already on
+                # disk. A cold server may even time out here (its
+                # ensure_sidecar parses the whole cohort before
+                # responding) — the client then just parses locally.
+                if _http_code(e) != 404:
+                    print(
+                        f"WARNING: sidecar export failed ({e}); the "
+                        "mirror will parse locally instead.",
+                        file=sys.stderr,
+                    )
+                for name in (SIDECAR_BASENAME, MIRROR_SIDECAR_OK):
+                    try:
+                        os.remove(os.path.join(tmp, name))
+                    except OSError:
+                        pass
+            # The mirror's files downloaded over a window in which the
+            # server cohort may have CHANGED (mixing old JSONL with a new
+            # sidecar — or new JSONL tail with old head). Re-verify the
+            # identity before marking complete: a swap mid-download makes
+            # the whole mirror junk, trusted sidecar or not.
+            with self._request("/identity", {}) as resp:
+                now_ident = json.load(resp)["identity"]
+            if now_ident != ident:
+                raise IOError(
+                    "server cohort changed while mirroring "
+                    f"(identity {ident} -> {now_ident}); rerun to mirror "
+                    "the new cohort"
+                )
+            open(os.path.join(tmp, MIRROR_COMPLETE_MARKER), "w").close()
             try:
                 os.rename(tmp, root)
             except OSError:
                 # Lost a populate race: the winner's mirror is identical
                 # by identity — never touch an existing complete root
                 # (another process may be reading it right now).
-                if not os.path.exists(os.path.join(root, ".complete")):
+                if not os.path.exists(os.path.join(root, MIRROR_COMPLETE_MARKER)):
                     raise
                 shutil.rmtree(tmp, ignore_errors=True)
         except BaseException:
