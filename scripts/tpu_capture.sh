@@ -17,11 +17,17 @@ export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 OUT="${1:-/tmp/tpu_capture}"
 mkdir -p "$OUT"
 
-if ! curl -s -m 5 http://127.0.0.1:8093/ >/dev/null 2>&1; then
+# TPU_CAPTURE_FORCE=1 skips the liveness gate: a CPU rehearsal of the
+# whole harvest so harness bugs are found BEFORE a real relay window,
+# not during one. Forcing defaults JAX_PLATFORMS=cpu — without it every
+# step would hang dialing the dead relay for its full timeout.
+if [ "${TPU_CAPTURE_FORCE:-}" = "1" ]; then
+  export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+elif ! curl -s -m 5 http://127.0.0.1:8093/ >/dev/null 2>&1; then
   echo "relay dead (8093 unreachable); aborting" >&2
   exit 7
 fi
-echo "relay alive; capturing to $OUT" >&2
+echo "relay alive (or forced); capturing to $OUT" >&2
 
 # 0. Proof of life FIRST: one JSON line per milestone, flushed — the relay
 #    died ~2 min into round 3 before bench.py could have finished its
