@@ -41,8 +41,14 @@ echo "quick probe rc=$? ($(wc -l <"$OUT/quick_probe.jsonl" 2>/dev/null) lines)" 
 timeout 1800 python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
 echo "bench rc=$? ($(tail -c 300 "$OUT/bench.json" 2>/dev/null))" >&2
 
-# 2. Gramian mode table: f32/int8 einsum vs both Pallas kernels — the
-#    default-picking data (NOTES agenda #1, VERDICT #5).
+# 2. Gramian mode probe — THE decision instrument (end-to-end per-mode
+#    timings incl. transfer; the microbench below is ordering-only
+#    because chained dispatches overlap through the tunnel).
+timeout 1800 python scripts/tpu_mode_probe.py \
+  >"$OUT/mode_probe.jsonl" 2>"$OUT/mode_probe.log"
+echo "mode probe rc=$? ($(wc -l <"$OUT/mode_probe.jsonl" 2>/dev/null) lines)" >&2
+
+# 2b. Gramian mode table (relative ordering cross-check).
 timeout 1800 python scripts/tpu_microbench.py \
   >"$OUT/microbench.txt" 2>"$OUT/microbench.log"
 echo "microbench rc=$?" >&2

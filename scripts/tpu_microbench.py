@@ -1,9 +1,12 @@
 """TPU micro-benchmark: compare every Gramian mode on real hardware.
 
-The round-2 starter (NOTES.md agenda #1): runs each accumulation mode on
-the 1000-Genomes-scale block shape and prints a table — the data needed to
-pick production defaults (f32 einsum vs int8 einsum vs pallas dense vs
-pallas sym) that round 1 could not measure (tunnel died; see NOTES.md).
+Runs each accumulation mode on the 1000-Genomes-scale block shape and
+prints a table. CAVEAT (learned in round 3): chained dispatches through
+the axon tunnel overlap asynchronously, so the absolute GFLOP/s here can
+exceed hardware peak — trust only the relative ordering, and prefer
+scripts/tpu_mode_probe.py (end-to-end per-mode timings) for decisions.
+The Pallas kernel rows were removed with the kernels themselves (they
+lost to the XLA einsum ~10x end-to-end; ops/gramian.py docstring).
 
 Usage (needs the TPU relay alive):
     python scripts/tpu_microbench.py [--samples 2504] [--block 8192] [--iters 8]
@@ -28,11 +31,6 @@ def main() -> int:
     p.add_argument("--samples", type=int, default=2504)
     p.add_argument("--block", type=int, default=8192)
     p.add_argument("--iters", type=int, default=8)
-    p.add_argument(
-        "--interpret",
-        action="store_true",
-        help="Pallas interpret mode (CPU smoke testing; not a benchmark)",
-    )
     args = p.parse_args()
 
     import jax
@@ -41,27 +39,21 @@ def main() -> int:
     print(f"devices: {jax.devices()}", file=sys.stderr)
     from spark_examples_tpu.arrays.blocks import round_up_multiple
     from spark_examples_tpu.ops.gramian import gramian_accumulate
-    from spark_examples_tpu.ops.pallas_gramian import (
-        BLOCK_N,
-        _mirror_lower,
-        _sym_accumulate_lower,
-        gramian_accumulate_pallas,
-    )
 
     n = args.samples
-    n_pad = round_up_multiple(n, BLOCK_N)
+    n_pad = round_up_multiple(n, 128)
     rng = np.random.default_rng(0)
     x = (rng.random((n_pad, args.block)) < 0.1).astype(np.int8)
     xd = jax.device_put(x)
 
-    def timed(name, init, step, finish=lambda g: g):
+    def timed(name, init, step):
         g = init()
-        g = step(g, xd)  # compile + warm (incl. the finish transform)
-        jax.block_until_ready(finish(g))
+        g = step(g, xd)  # compile + warm
+        jax.block_until_ready(g)
         t0 = time.perf_counter()
         for _ in range(args.iters):
             g = step(g, xd)
-        jax.block_until_ready(finish(g))
+        jax.block_until_ready(g)
         dt = (time.perf_counter() - t0) / args.iters
         gflops = 2 * n_pad * n_pad * args.block / dt / 1e9
         print(f"{name:16s} {dt*1e3:9.2f} ms/block   {gflops:10.0f} GFLOP/s")
@@ -70,24 +62,17 @@ def main() -> int:
     zeros_f32 = lambda: jnp.zeros((n_pad, n_pad), jnp.float32)
     zeros_i32 = lambda: jnp.zeros((n_pad, n_pad), jnp.int32)
 
-    timed("einsum f32", zeros_f32, lambda g, x: gramian_accumulate(g, x))
+    timed(
+        "einsum f32",
+        zeros_f32,
+        lambda g, x: gramian_accumulate(g, x, compute_dtype=jnp.float32),
+    )
     timed(
         "einsum int8",
         zeros_i32,
         lambda g, x: gramian_accumulate(g, x, compute_dtype=jnp.int8),
     )
-    interp = args.interpret
-    timed(
-        "pallas dense",
-        zeros_f32,
-        lambda g, x: gramian_accumulate_pallas(g, x, interpret=interp),
-    )
-    timed(
-        "pallas sym",
-        zeros_f32,
-        lambda g, x: _sym_accumulate_lower(g, x, interpret=interp),
-        finish=_mirror_lower,
-    )
+    timed("einsum auto", zeros_f32, lambda g, x: gramian_accumulate(g, x))
     return 0
 
 

@@ -1,0 +1,103 @@
+"""Decisive Gramian-mode probe: full-phase timings at bench scale.
+
+Round-3 motivation: the first on-chip capture produced contradictory
+mode evidence. ``scripts/tpu_microbench.py`` (N padded to 2560, chained
+dispatches) ranked int8 einsum ~3x faster than f32, while ``bench.py``
+(N=2504 unpadded, end-to-end with host->device transfer) measured int8
+~20x SLOWER than f32. The suspected cause is the unpadded sample axis
+falling off the integer-MXU tiling. This probe settles it: every mode is
+timed over the SAME end-to-end phase bench.py measures (host blocks ->
+device stream -> accumulated G, block_until_ready), at both N=2504 and
+the 128-padded N=2560, twice each (second rep reported; first warms).
+
+Usage (relay alive): python scripts/tpu_mode_probe.py [--blocks 8]
+Prints one JSON line per (mode, n) measurement, flushed immediately —
+a mid-run relay death keeps earlier rows.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--samples", type=int, default=2504)
+    p.add_argument("--block", type=int, default=8192)
+    p.add_argument("--blocks", type=int, default=8)
+    p.add_argument("--reps", type=int, default=2)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from spark_examples_tpu.arrays.blocks import round_up_multiple
+    from spark_examples_tpu.ops.gramian import gramian_blockwise
+    from spark_examples_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        )
+    )
+
+    def emit(row):
+        print(json.dumps(row), flush=True)
+
+    emit({"devices": [str(d) for d in jax.devices()]})
+
+    rng = np.random.default_rng(0)
+    base = [
+        (rng.random((args.samples, args.block)) < 0.1).astype(np.int8)
+        for _ in range(args.blocks)
+    ]
+    n_pad = round_up_multiple(args.samples, 128)
+    padded = [
+        np.pad(b, ((0, n_pad - args.samples), (0, 0))) for b in base
+    ]
+
+    configs = [
+        ("auto", args.samples, base, {}),
+        ("f32", args.samples, base, dict(compute_dtype=jnp.float32)),
+        ("int8", args.samples, base,
+         dict(compute_dtype=jnp.int8, accum_dtype=jnp.int32)),
+        ("f32_pad128", n_pad, padded, dict(compute_dtype=jnp.float32)),
+        ("int8_pad128", n_pad, padded,
+         dict(compute_dtype=jnp.int8, accum_dtype=jnp.int32)),
+        ("bf16_pad128", n_pad, padded, dict(compute_dtype=jnp.bfloat16)),
+    ]
+    for name, n, blocks, kw in configs:
+        try:
+            times = []
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                g = gramian_blockwise(blocks, n, **kw)
+                jax.block_until_ready(g)
+                times.append(time.perf_counter() - t0)
+            del g
+            emit(
+                {
+                    "mode": name,
+                    "n": n,
+                    "v": args.block * args.blocks,
+                    "first_s": round(times[0], 4),
+                    "steady_s": round(min(times[1:]) if len(times) > 1
+                                      else times[0], 4),
+                }
+            )
+        except Exception as e:  # noqa: BLE001 — record and keep probing
+            emit({"mode": name, "n": n, "error": f"{type(e).__name__}: {e}"})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

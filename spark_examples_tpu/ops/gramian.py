@@ -14,15 +14,27 @@ single pair co-occurring at every variant would still need f64/int paths;
 ``gramian_blockwise`` therefore accumulates into an f64-safe int32/float32
 choice via ``accum_dtype``).
 
-TPU notes: X is stored int8 host-side (HBM-friendly), cast per block to
-``compute_dtype`` (default bfloat16 would NOT be exact for large V per block;
-default is float32 which is exact per 0/1 block up to 2^24 — and block sizes
-are ≤ 2^20, so per-block products are exact; cross-block accumulation happens
-in ``accum_dtype``).
+TPU notes: X is stored int8 host-side (HBM-friendly). By default the
+per-block product rides the **integer MXU**: int8×int8→int32, then the exact
+int32 counts are cast into the accumulator dtype (float32 by default, exact
+below 2^24 total co-occurrences per pair). Measured on a real TPU v5 lite at
+the bench shape (N=2504, V=65536, end-to-end blockwise stream including
+host→device transfer; ``tpu_capture_r03/mode_probe.jsonl``):
+
+    int8 einsum 0.197s | f32 einsum 0.353s | bf16 0.312s |
+    pallas dense 2.75s | pallas sym 2.19s
+
+so int8 is 1.8× over f32 and both hand-written Pallas kernels lost to the
+XLA einsum by ~10× end-to-end — the Pallas path was deleted on that
+evidence (they remain in git history; the hardware bit-exactness suite had
+them at parity numerically). ``SPARK_EXAMPLES_TPU_GRAMIAN=f32`` forces the
+matmul itself into the accumulator dtype (escape hatch; observably
+identical results either way — both paths are exact integer counts).
 """
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Iterable
 
@@ -30,28 +42,96 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["gramian", "gramian_accumulate", "gramian_blockwise"]
+__all__ = [
+    "gramian",
+    "gramian_accumulate",
+    "gramian_blockwise",
+    "mxu_cross_product",
+    "resolve_gramian_compute_dtype",
+]
+
+
+def resolve_gramian_compute_dtype(x_dtype, out_dtype, compute_dtype=None):
+    """Pick the MXU dtype for one Gramian call — OUTSIDE any jit trace.
+
+    Every public entry point resolves the mode here before entering its
+    jitted body, so ``SPARK_EXAMPLES_TPU_GRAMIAN`` is consulted (and
+    validated) on every call rather than frozen into the first trace's
+    cached executable. Policy: explicit ``compute_dtype`` wins; env
+    ``f32`` forces the matmul into ``out_dtype``; env ``int8`` forces the
+    integer MXU; default rides the integer MXU whenever X is stored int8.
+    """
+    if compute_dtype is not None:
+        return compute_dtype
+    forced = os.environ.get("SPARK_EXAMPLES_TPU_GRAMIAN", "")
+    if forced not in ("", "auto", "int8", "f32"):
+        raise ValueError(
+            f"SPARK_EXAMPLES_TPU_GRAMIAN={forced!r}: expected 'auto', "
+            "'int8', or 'f32'"
+        )
+    if forced == "f32":
+        return out_dtype
+    if forced == "int8" or x_dtype == jnp.int8:
+        return jnp.int8
+    return out_dtype
+
+
+def mxu_cross_product(x, out_dtype, compute_dtype=None):
+    """``X @ X.T`` in the fastest exact dtype path for 0/1 indicators.
+
+    The single mode-policy seam shared by every Gramian entry point
+    (single-device and sharded): int8-stored blocks ride the integer MXU
+    (int8×int8→int32, 1.8× over f32 on TPU v5e — module docstring table)
+    and the exact int32 product is cast to ``out_dtype``; anything else
+    computes directly in ``out_dtype``. NOTE: when called inside a jit /
+    shard_map trace with ``compute_dtype=None``, the env escape hatch is
+    resolved at trace time — callers that want per-call env semantics
+    must resolve via :func:`resolve_gramian_compute_dtype` outside the
+    trace (all public entry points here and in ``parallel/sharded`` do).
+    """
+    compute_dtype = resolve_gramian_compute_dtype(
+        x.dtype, out_dtype, compute_dtype
+    )
+    xf = x.astype(compute_dtype)
+    if compute_dtype == jnp.int8:
+        prod = jnp.einsum(
+            "nv,mv->nm", xf, xf, preferred_element_type=jnp.int32
+        )
+        return prod.astype(out_dtype)
+    return jnp.einsum("nv,mv->nm", xf, xf, preferred_element_type=out_dtype)
 
 
 @partial(jax.jit, static_argnames=("compute_dtype", "accum_dtype"))
-def gramian(x, compute_dtype=jnp.float32, accum_dtype=jnp.float32):
+def _gramian_jit(x, compute_dtype, accum_dtype):
+    return mxu_cross_product(x, accum_dtype, compute_dtype)
+
+
+def gramian(x, compute_dtype=None, accum_dtype=jnp.float32):
     """``G = X @ X.T`` for a 0/1 genotype-indicator block.
 
     Args:
       x: ``(n_samples, n_variants)`` array, any integer/float dtype with 0/1
         values (int8 preferred for storage).
-      compute_dtype: dtype the matmul runs in on the MXU.
+      compute_dtype: dtype the matmul runs in on the MXU; ``None`` picks the
+        measured-fastest exact path (int8 for int8 storage, modulo the env
+        escape hatch).
       accum_dtype: dtype of the returned Gramian.
 
     Returns:
       ``(n_samples, n_samples)`` symmetric co-occurrence matrix.
     """
-    xf = x.astype(compute_dtype)
-    return jnp.einsum("nv,mv->nm", xf, xf, preferred_element_type=accum_dtype)
+    compute_dtype = resolve_gramian_compute_dtype(
+        x.dtype, accum_dtype, compute_dtype
+    )
+    return _gramian_jit(x, compute_dtype, accum_dtype)
 
 
 @partial(jax.jit, static_argnames=("compute_dtype",), donate_argnums=(0,))
-def gramian_accumulate(g, x_block, compute_dtype=jnp.float32):
+def _gramian_accumulate_jit(g, x_block, compute_dtype):
+    return g + mxu_cross_product(x_block, g.dtype, compute_dtype)
+
+
+def gramian_accumulate(g, x_block, compute_dtype=None):
     """One blockwise-accumulation step: ``G += X_blk @ X_blk.T``.
 
     This is the variant-axis streaming primitive (the reference's
@@ -60,17 +140,18 @@ def gramian_accumulate(g, x_block, compute_dtype=jnp.float32):
     unbounded while G stays fixed at N×N on device. ``g`` is donated so the
     accumulator updates in place in HBM.
     """
-    xf = x_block.astype(compute_dtype)
-    return g + jnp.einsum("nv,mv->nm", xf, xf, preferred_element_type=g.dtype)
+    compute_dtype = resolve_gramian_compute_dtype(
+        x_block.dtype, g.dtype, compute_dtype
+    )
+    return _gramian_accumulate_jit(g, x_block, compute_dtype)
 
 
 def gramian_blockwise(
     blocks: Iterable[np.ndarray],
     n_samples: int,
     accum_dtype=jnp.float32,
-    compute_dtype=jnp.float32,
+    compute_dtype=None,
     device=None,
-    use_pallas=None,
 ):
     """Stream variant blocks through ``G += X_blk @ X_blk.T`` on device.
 
@@ -91,64 +172,9 @@ def gramian_blockwise(
     """
     from spark_examples_tpu.arrays.feed import device_prefetch
 
-    default_dtypes = (
-        accum_dtype == jnp.float32 and compute_dtype == jnp.float32
-    )
-    if use_pallas is None:
-        from spark_examples_tpu.ops.pallas_gramian import pallas_enabled
-
-        use_pallas = pallas_enabled() and jax.default_backend() == "tpu"
-    # The Pallas kernel accumulates in float32 only; honor explicit dtype
-    # requests by staying on the einsum path rather than silently
-    # downgrading.
-    if use_pallas and default_dtypes:
-        return _gramian_blockwise_pallas(blocks, n_samples, device)
-
     g = jnp.zeros((n_samples, n_samples), dtype=accum_dtype)
     if device is not None:
         g = jax.device_put(g, device)
     for xb in device_prefetch(blocks, device=device):
         g = gramian_accumulate(g, xb, compute_dtype=compute_dtype)
     return g
-
-
-def _gramian_blockwise_pallas(blocks, n_samples, device=None):
-    """Pallas-kernel accumulation path (opt-in; see ops/pallas_gramian.py).
-
-    Pads the sample axis to the kernel's tile multiple (zero rows are inert)
-    and each block's variant axis likewise; trims before returning.
-    """
-    from spark_examples_tpu.arrays.blocks import round_up_multiple
-    from spark_examples_tpu.arrays.feed import device_prefetch
-    from spark_examples_tpu.ops.pallas_gramian import (
-        BLOCK_N,
-        BLOCK_V,
-        _mirror_lower,
-        _sym_accumulate_lower,
-        gramian_accumulate_pallas,
-        pallas_mode,
-    )
-
-    sym = pallas_mode() == "sym"
-    # Sym mode accumulates the lower triangle only across all blocks and
-    # mirrors ONCE at the end (per-block mirroring would spend O(N²) HBM
-    # traffic per block on a bandwidth-bound kernel).
-    accumulate = _sym_accumulate_lower if sym else gramian_accumulate_pallas
-    n_pad = round_up_multiple(n_samples, BLOCK_N)
-
-    def padded():
-        for block in blocks:
-            xb = np.asarray(block)
-            v_pad = round_up_multiple(xb.shape[1], BLOCK_V)
-            yield np.pad(
-                xb, ((0, n_pad - n_samples), (0, v_pad - xb.shape[1]))
-            )
-
-    g = jnp.zeros((n_pad, n_pad), dtype=jnp.float32)
-    if device is not None:
-        g = jax.device_put(g, device)
-    for xb in device_prefetch(padded(), device=device):
-        g = accumulate(g, xb)
-    if sym:
-        g = _mirror_lower(g)
-    return g[:n_samples, :n_samples]

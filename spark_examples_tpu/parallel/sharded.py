@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_examples_tpu.ops.centering import double_center
+from spark_examples_tpu.ops.gramian import mxu_cross_product
 from spark_examples_tpu.ops.pcoa import (
     SpectralGapWarning,
     check_spectral_gap,
@@ -54,7 +55,7 @@ def _mesh_axes(mesh: Mesh):
     return DATA_AXIS, (MODEL_AXIS if has_model else None)
 
 
-def gramian_variant_parallel(x, mesh: Mesh, compute_dtype=jnp.float32):
+def gramian_variant_parallel(x, mesh: Mesh, compute_dtype=None):
     """``G = psum_over_devices(X_loc @ X_loc.T)`` with X variant-sharded.
 
     ``x``: (N, V) with V divisible by the data-axis size. Returns G
@@ -68,10 +69,7 @@ def gramian_variant_parallel(x, mesh: Mesh, compute_dtype=jnp.float32):
         out_specs=P(None, None),
     )
     def _local_gramian(x_loc):
-        xf = x_loc.astype(compute_dtype)
-        g_loc = jnp.einsum(
-            "nv,mv->nm", xf, xf, preferred_element_type=jnp.float32
-        )
+        g_loc = mxu_cross_product(x_loc, jnp.float32, compute_dtype)
         return jax.lax.psum(g_loc, DATA_AXIS)
 
     return jax.jit(_local_gramian)(x)
@@ -120,10 +118,7 @@ def _accumulate_blocks(
 
     @partial(jax.jit, donate_argnums=(0,), out_shardings=g_sharding)
     def _accum(g, xb):
-        xf = xb.astype(compute_dtype)
-        return g + jnp.einsum(
-            "nv,mv->nm", xf, xf, preferred_element_type=g.dtype
-        )
+        return g + mxu_cross_product(xb, g.dtype, compute_dtype)
 
     def padded_blocks():
         for block in blocks:
@@ -157,7 +152,7 @@ def sharded_gramian_blockwise(
     n_samples: int,
     mesh: Mesh,
     accum_dtype=jnp.float32,
-    compute_dtype=jnp.float32,
+    compute_dtype=None,
 ):
     """Stream variant blocks into a mesh-sharded Gramian accumulator.
 
@@ -178,7 +173,7 @@ def sharded_gramian_blockwise(
     )
 
 
-def gramian_variant_parallel_ring(x, mesh: Mesh, compute_dtype=jnp.float32):
+def gramian_variant_parallel_ring(x, mesh: Mesh, compute_dtype=None):
     """Variant-parallel Gramian with an explicit ring reduction.
 
     Same math as :func:`gramian_variant_parallel` but the cross-device
@@ -204,10 +199,7 @@ def gramian_variant_parallel_ring(x, mesh: Mesh, compute_dtype=jnp.float32):
         check_vma=False,
     )
     def _ring(x_loc):
-        xf = x_loc.astype(compute_dtype)
-        g_loc = jnp.einsum(
-            "nv,mv->nm", xf, xf, preferred_element_type=jnp.float32
-        )
+        g_loc = mxu_cross_product(x_loc, jnp.float32, compute_dtype)
 
         def body(_, carry):
             acc, buf = carry
@@ -232,7 +224,7 @@ def gramian_blockwise_global(
     local_blocks,
     n_samples: int,
     mesh: Mesh,
-    compute_dtype=jnp.float32,
+    compute_dtype=None,
     accum_dtype=jnp.float32,
 ):
     """Multi-controller blockwise Gramian: one mesh spanning every process.
@@ -304,7 +296,7 @@ def sharded_gramian_blockwise_global(
     local_blocks,
     n_samples: int,
     mesh: Mesh,
-    compute_dtype=jnp.float32,
+    compute_dtype=None,
     accum_dtype=jnp.float32,
 ):
     """Pod-mode blockwise Gramian with G *sample-sharded* over the mesh.
