@@ -1,0 +1,106 @@
+"""On-chip dense-eigh vs randomized-subspace crossover probe.
+
+Feeds the `--dense-eigh-limit` default (currently 8192, set before any
+hardware data existed): at each N, time the dense ``pcoa`` path (eigh)
+and the randomized path (fixed 30-iter sweep and adaptive ``tol=1e-6``)
+on the same double-centered population-structure Gramian. First-call
+(compile, uncached) and steady-state are reported separately — through
+the axon tunnel the one-time eigh compile is minutes at N≈2500, which
+is itself decision data for cold-start-sensitive deployments.
+
+Usage (relay alive): python scripts/tpu_eig_probe.py [--sizes 1024,2048,4096]
+One flushed JSON line per measurement; a mid-run relay death keeps
+earlier rows.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--sizes", default="1024,2048,4096")
+    p.add_argument("--variants", type=int, default=4096)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from spark_examples_tpu.ops import gramian_blockwise, pcoa
+    from spark_examples_tpu.ops.centering import double_center
+    from spark_examples_tpu.parallel.sharded import topk_eig_randomized
+    from spark_examples_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        )
+    )
+
+    def emit(row):
+        print(json.dumps(row), flush=True)
+
+    emit({"devices": [str(d) for d in jax.devices()]})
+
+    import warnings
+
+    for n in [int(s) for s in args.sizes.split(",")]:
+        # Population-structure cohort (the realistic spectrum: a few
+        # dominant eigenvalues over a bulk) via structured random blocks.
+        rng = np.random.default_rng(0)
+        groups = rng.integers(0, 3, size=n)
+        base = rng.random((3, args.variants)) < 0.15
+        x = (
+            (rng.random((n, args.variants)) < 0.05) | base[groups]
+        ).astype(np.int8)
+        g = gramian_blockwise([x], n)
+        c = jax.jit(double_center)(g)
+        jax.block_until_ready(c)
+
+        for name, fn in (
+            ("dense_pcoa", lambda: pcoa(g, 2)[0]),
+            (
+                "rand30",
+                lambda: topk_eig_randomized(c, 2)[0],
+            ),
+            (
+                "rand_tol1e6",
+                lambda: topk_eig_randomized(c, 2, tol=1e-6)[0],
+            ),
+        ):
+            try:
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    t0 = time.perf_counter()
+                    out = fn()
+                    jax.block_until_ready(out)
+                    first = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    out = fn()
+                    jax.block_until_ready(out)
+                    steady = time.perf_counter() - t0
+                emit(
+                    {
+                        "n": n,
+                        "path": name,
+                        "first_s": round(first, 3),
+                        "steady_s": round(steady, 4),
+                    }
+                )
+            except Exception as e:  # noqa: BLE001 — record, keep probing
+                emit({"n": n, "path": name, "error": f"{type(e).__name__}: {e}"})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
