@@ -77,6 +77,39 @@ def test_int8_int32_gramian_exact():
     np.testing.assert_array_equal(np.asarray(g_int), np.asarray(g_f32))
 
 
+def test_gramian_env_escape_hatch_per_call(monkeypatch):
+    """SPARK_EXAMPLES_TPU_GRAMIAN is resolved OUTSIDE jit on every call:
+    flipping it after a first (cached) trace must still take effect, and
+    an invalid value must raise even after prior successful calls — the
+    round-3 review found the original trace-time read silently froze the
+    first call's choice into the jit cache."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from spark_examples_tpu.ops.gramian import (
+        gramian,
+        resolve_gramian_compute_dtype,
+    )
+
+    x = (np.random.default_rng(0).random((16, 32)) < 0.4).astype(np.int8)
+    g_auto = np.asarray(gramian(x))  # traces+caches the int8 auto path
+    assert resolve_gramian_compute_dtype(x.dtype, jnp.float32) == jnp.int8
+
+    monkeypatch.setenv("SPARK_EXAMPLES_TPU_GRAMIAN", "f32")
+    assert (
+        resolve_gramian_compute_dtype(x.dtype, jnp.float32) == jnp.float32
+    )
+    np.testing.assert_array_equal(np.asarray(gramian(x)), g_auto)
+
+    monkeypatch.setenv("SPARK_EXAMPLES_TPU_GRAMIAN", "bogus")
+    try:
+        gramian(x)
+    except ValueError as e:
+        assert "SPARK_EXAMPLES_TPU_GRAMIAN" in str(e)
+    else:
+        raise AssertionError("invalid env value must raise per call")
+
+
 def test_debug_numerics_and_range_guard():
     import numpy as np
     import jax.numpy as jnp
