@@ -74,6 +74,7 @@ def main() -> int:
         ("int8_pad128", n_pad, padded,
          dict(compute_dtype=jnp.int8, accum_dtype=jnp.int32)),
         ("bf16_pad128", n_pad, padded, dict(compute_dtype=jnp.bfloat16)),
+        ("int8_packed", args.samples, base, dict(packed=True)),
     ]
     for name, n, blocks, kw in configs:
         try:

@@ -77,6 +77,34 @@ def test_int8_int32_gramian_exact():
     np.testing.assert_array_equal(np.asarray(g_int), np.asarray(g_f32))
 
 
+def test_gramian_packed_transfer_path_bit_identical():
+    """The bit-packed transfer path (8x fewer host->device bytes) must be
+    bit-identical to the dense path, including non-multiple-of-8 block
+    widths whose packbits pad bits unpack to inert zero columns."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from spark_examples_tpu.ops.gramian import (
+        gramian_blockwise,
+        pack_indicator_block,
+        unpack_indicator_block,
+    )
+
+    rng = np.random.default_rng(3)
+    for n, v in ((17, 96), (33, 100)):
+        blocks = [
+            (rng.random((n, v)) < 0.2).astype(np.int8) for _ in range(3)
+        ]
+        dense = np.asarray(gramian_blockwise(blocks, n))
+        packed = np.asarray(gramian_blockwise(blocks, n, packed=True))
+        np.testing.assert_array_equal(dense, packed)
+        xp = pack_indicator_block(blocks[0])
+        np.testing.assert_array_equal(
+            np.asarray(unpack_indicator_block(jnp.asarray(xp), v)),
+            blocks[0],
+        )
+
+
 def test_gramian_env_escape_hatch_per_call(monkeypatch):
     """SPARK_EXAMPLES_TPU_GRAMIAN is resolved OUTSIDE jit on every call:
     flipping it after a first (cached) trace must still take effect, and
