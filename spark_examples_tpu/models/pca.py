@@ -339,15 +339,19 @@ class VariantsPcaDriver:
             )
 
             if self._sample_sharded():
-                g = sharded_gramian_blockwise_global(blocks, n, self.mesh)
+                g = sharded_gramian_blockwise_global(
+                    blocks, n, self.mesh, packed=True
+                )
             else:
-                g = gramian_blockwise_global(blocks, n, self.mesh)
+                g = gramian_blockwise_global(
+                    blocks, n, self.mesh, packed=True
+                )
         elif self.mesh is not None:
             from spark_examples_tpu.parallel.sharded import (
                 sharded_gramian_blockwise,
             )
 
-            g = sharded_gramian_blockwise(blocks, n, self.mesh)
+            g = sharded_gramian_blockwise(blocks, n, self.mesh, packed=True)
         else:
             # packed=True: blocks_from_calls yields 0/1 indicators, so the
             # bit-packed transfer (8× fewer host→device bytes; on-chip

@@ -30,7 +30,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_examples_tpu.ops.centering import double_center
-from spark_examples_tpu.ops.gramian import mxu_cross_product
+from spark_examples_tpu.ops.gramian import (
+    mxu_cross_product,
+    pack_indicator_block,
+    unpack_indicator_block,
+)
 from spark_examples_tpu.ops.pcoa import (
     SpectralGapWarning,
     check_spectral_gap,
@@ -97,6 +101,7 @@ def _accumulate_blocks(
     g_sharding: NamedSharding,
     compute_dtype,
     accum_dtype,
+    packed: bool = False,
 ):
     """Shared blockwise-Gramian core: pad, zero-init, accumulate, trim.
 
@@ -109,15 +114,30 @@ def _accumulate_blocks(
     N comes from the cohort's callset count, which is arbitrary, and
     device_put needs sharded dimensions to divide evenly. Zero rows are
     inert in X @ X.T (zero rows/cols of G), trimmed before returning.
+
+    ``packed=True`` (for 0/1 indicator blocks only) bit-packs each block
+    host-side after padding — 8× fewer bytes over every host→device feed,
+    the same on-chip-measured win as the single-device path — and the
+    jitted accumulator unpacks before the matmul. The packed column count
+    is zero-byte-padded up to the variant-axis sharding divisor (zero
+    bytes unpack to inert zero columns), and the synced global stream
+    syncs on packed widths, which preserves its no-one-sided-deadlock
+    guarantee (equal packed widths ⇒ equal global shapes).
     """
     from spark_examples_tpu.arrays.blocks import round_up_multiple
 
     n_padded = round_up_multiple(
         n_samples, _axis_product(mesh, g_sharding.spec)
     )
+    v_spec = (
+        x_sharding.spec[1] if len(x_sharding.spec) > 1 else None
+    )
+    v_div = _axis_product(mesh, P(v_spec))
 
     @partial(jax.jit, donate_argnums=(0,), out_shardings=g_sharding)
     def _accum(g, xb):
+        if packed:
+            xb = unpack_indicator_block(xb, 8 * xb.shape[1])
         return g + mxu_cross_product(xb, g.dtype, compute_dtype)
 
     def padded_blocks():
@@ -125,13 +145,21 @@ def _accumulate_blocks(
             xb = np.asarray(block)
             if n_padded != n_samples:
                 xb = np.pad(xb, ((0, n_padded - n_samples), (0, 0)))
+            if packed:
+                xb = pack_indicator_block(xb)
+                cols = round_up_multiple(xb.shape[1], v_div)
+                if cols != xb.shape[1]:
+                    xb = np.pad(xb, ((0, 0), (0, cols - xb.shape[1])))
             yield xb
 
     g = jax.device_put(
         jnp.zeros((n_padded, n_padded), dtype=accum_dtype), g_sharding
     )
+    fill_dtype = np.uint8 if packed else np.int8
     if _mesh_spans_processes(mesh):
-        stream = _synced_block_stream(padded_blocks(), n_padded, x_sharding)
+        stream = _synced_block_stream(
+            padded_blocks(), n_padded, x_sharding, fill_dtype=fill_dtype
+        )
     else:
         from spark_examples_tpu.arrays.feed import device_prefetch
 
@@ -153,6 +181,7 @@ def sharded_gramian_blockwise(
     mesh: Mesh,
     accum_dtype=jnp.float32,
     compute_dtype=None,
+    packed: bool = False,
 ):
     """Stream variant blocks into a mesh-sharded Gramian accumulator.
 
@@ -170,6 +199,7 @@ def sharded_gramian_blockwise(
         NamedSharding(mesh, P(d_axis, m_axis)),
         compute_dtype,
         accum_dtype,
+        packed=packed,
     )
 
 
@@ -226,6 +256,7 @@ def gramian_blockwise_global(
     mesh: Mesh,
     compute_dtype=None,
     accum_dtype=jnp.float32,
+    packed: bool = False,
 ):
     """Multi-controller blockwise Gramian: one mesh spanning every process.
 
@@ -253,10 +284,13 @@ def gramian_blockwise_global(
         NamedSharding(mesh, P(None, None)),
         compute_dtype,
         accum_dtype,
+        packed=packed,
     )
 
 
-def _synced_block_stream(local_blocks, n_samples: int, x_sharding):
+def _synced_block_stream(
+    local_blocks, n_samples: int, x_sharding, fill_dtype=np.int8
+):
     """Per-step width/liveness-synced global blocks from per-process streams.
 
     Factored from the pod-mode accumulators: every process learns every
@@ -286,7 +320,7 @@ def _synced_block_stream(local_blocks, n_samples: int, x_sharding):
             )
         width = live[0]
         if block is None:
-            block = np.zeros((n_samples, width), np.int8)
+            block = np.zeros((n_samples, width), fill_dtype)
         yield jax.make_array_from_process_local_data(
             x_sharding, np.asarray(block)
         )
@@ -298,6 +332,7 @@ def sharded_gramian_blockwise_global(
     mesh: Mesh,
     compute_dtype=None,
     accum_dtype=jnp.float32,
+    packed: bool = False,
 ):
     """Pod-mode blockwise Gramian with G *sample-sharded* over the mesh.
 
@@ -324,6 +359,7 @@ def sharded_gramian_blockwise_global(
         NamedSharding(mesh, P(d_axis, m_axis)),
         compute_dtype,
         accum_dtype,
+        packed=packed,
     )
 
 

@@ -63,6 +63,18 @@ class TestShardedGramian:
             np.asarray(g), np.asarray(gramian(x_small))
         )
 
+    def test_blockwise_sharded_packed_bit_identical(self, x_small):
+        """The bit-packed feed (the production default in the model) must
+        be bit-identical to the unpacked sharded path, including a block
+        width (100) that is neither a multiple of 8 nor of the mesh's
+        variant-axis divisor — pad bytes unpack to inert zero columns."""
+        mesh = make_mesh("data:4,model:2")
+        ragged = [x_small[:, :100], x_small[:, 100:200], x_small[:, 200:]]
+        want = np.asarray(gramian(x_small))
+        got = sharded_gramian_blockwise(ragged, 32, mesh, packed=True)
+        assert len(got.sharding.device_set) == 8
+        np.testing.assert_array_equal(np.asarray(got), want)
+
 
 class TestShardedEig:
     def test_randomized_topk_matches_eigh(self):
