@@ -1087,19 +1087,21 @@ class _CsrCohort:
         ):
             yield calls
 
-    def _rows(self, shard, indexes, variant_set_id, stats, min_af,
-              keep_empty):
-        """Shared shard query: yields (absolute row index, calls list)."""
+    def _shard_keep(self, shard, indexes, variant_set_id, stats, min_af):
+        """Shared vectorized shard prefix: (a, b, keep mask, lookup) —
+        the row window, the vsid+AF keep mask (stats counted exactly as
+        the row path always has: after the vsid filter, before AF), and
+        the callset-ordinal → dense-index lookup table."""
         d = self._d
         seg = self.segments.get(_strip_chr(shard.contig))
         if seg is None:
-            return
+            return None
         lo, hi = seg
         starts = d["starts"]
         a = lo + int(np.searchsorted(starts[lo:hi], shard.start, "left"))
         b = lo + int(np.searchsorted(starts[lo:hi], shard.end, "left"))
         if a == b:
-            return
+            return None
         keep = np.ones(b - a, dtype=bool)
         if variant_set_id:
             allowed = self._allowed_by_vsid.get(variant_set_id)
@@ -1125,7 +1127,54 @@ class _CsrCohort:
                 if cid in indexes:
                     lookup[i] = indexes[cid]
             self._lookup, self._lookup_indexes = lookup, indexes
-        lookup = self._lookup
+        return a, b, keep, self._lookup
+
+    def carrying_csr(self, shard, indexes, variant_set_id, stats, min_af):
+        """The shard's carrying lists as one CSR pair (indices, offsets)
+        — numpy end to end, no per-variant Python lists.
+
+        Row semantics are exactly :meth:`carrying` (keep_empty=False:
+        variants with no carriers are dropped); profiling the warm
+        all-autosomes run showed ~85% of host wall-clock was the
+        array→list→array round-trip this method eliminates.
+
+        Returns ``(indices, offsets)`` with ``offsets`` of length
+        rows+1, or None for an empty window.
+        """
+        pre = self._shard_keep(shard, indexes, variant_set_id, stats, min_af)
+        if pre is None:
+            return None
+        a, b, keep, lookup = pre
+        d = self._d
+        offsets = d["offsets"]
+        rows = a + np.nonzero(keep)[0]
+        lo = offsets[rows]
+        lens = offsets[rows + 1] - lo
+        nonempty = lens > 0
+        lo, lens = lo[nonempty], lens[nonempty]
+        if lo.size == 0:
+            return None
+        out_offs = np.zeros(lo.size + 1, dtype=np.int64)
+        np.cumsum(lens, out=out_offs[1:])
+        # Ragged gather of [lo_i, lo_i+len_i) ranges in one shot.
+        pos = np.repeat(lo, lens) + (
+            np.arange(out_offs[-1], dtype=np.int64)
+            - np.repeat(out_offs[:-1], lens)
+        )
+        mapped = lookup[d["ords"][pos]]
+        if (mapped < 0).any():
+            bad = int(d["ords"][pos][mapped < 0][0])
+            raise KeyError(str(d["callset_ids"][bad]))
+        return mapped, out_offs
+
+    def _rows(self, shard, indexes, variant_set_id, stats, min_af,
+              keep_empty):
+        """Shared shard query: yields (absolute row index, calls list)."""
+        pre = self._shard_keep(shard, indexes, variant_set_id, stats, min_af)
+        if pre is None:
+            return
+        a, b, keep, lookup = pre
+        d = self._d
         offsets = d["offsets"]
         ords = d["ords"]
         for row in np.nonzero(keep)[0].tolist():
@@ -1297,6 +1346,28 @@ class JsonlSource:
         :class:`_CsrCohort`)."""
         self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
         yield from self._ensure_csr().carrying(
+            shard,
+            indexes,
+            variant_set_id,
+            self.stats,
+            min_allele_frequency,
+        )
+
+    def stream_carrying_csr(
+        self,
+        variant_set_id: str,
+        shard: Shard,
+        indexes: dict,
+        min_allele_frequency: Optional[float] = None,
+    ):
+        """CSR-direct fused ingest: the shard's carrying lists as ONE
+        ``(indices, offsets)`` numpy pair straight off the sidecar — no
+        per-variant Python lists (the array→list→array round-trip was
+        ~85% of warm host wall-clock at all-autosomes scale). Identical
+        row/stats/AF/KeyError semantics to :meth:`stream_carrying`;
+        returns None for an empty shard window."""
+        self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
+        return self._ensure_csr().carrying_csr(
             shard,
             indexes,
             variant_set_id,
