@@ -977,7 +977,25 @@ class VariantsPcaDriver:
 
     def _ingest_shard_group(self, vsid: str, group, g):
         """Stream one shard group through filter → calls → Gramian blocks,
-        accumulating onto g (shared by both checkpointed ingest modes)."""
+        accumulating onto g (shared by both checkpointed ingest modes).
+        Prefers the CSR-direct tier (bit-identical blocks — parity
+        pinned — so snapshots and resume digests are unaffected)."""
+        if self._fused_csr_possible():
+            from spark_examples_tpu.arrays.blocks import blocks_from_csr
+
+            pairs = (
+                self.source.stream_carrying_csr(
+                    vsid,
+                    shard,
+                    self.index.indexes,
+                    self.conf.min_allele_frequency,
+                )
+                for shard in group
+            )
+            blocks = blocks_from_csr(
+                pairs, self.index.size, self.conf.block_variants
+            )
+            return self._blocks_to_gramian(blocks, g_init=g)
         fused = self._fused_ingest_possible()
 
         def group_calls():
