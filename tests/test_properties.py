@@ -100,3 +100,63 @@ class TestGramianProperties:
         c2 = np.asarray(double_center(c1))
         np.testing.assert_allclose(c1, c2, atol=1e-4)  # idempotent
         np.testing.assert_allclose(c1.mean(0), 0, atol=1e-5)
+
+
+class TestCsrBlockEquivalence:
+    """blocks_from_csr ≡ blocks_from_calls over ARBITRARY ragged shard
+    streams — beyond the cohort-shaped parity test: empty shards
+    (None), empty windows, variants spilling across block boundaries,
+    widths far from multiples of 8."""
+
+    @given(
+        st.lists(  # per-shard: list of per-variant carrier lists
+            st.one_of(
+                st.none(),
+                st.lists(
+                    st.lists(
+                        st.integers(0, 10), min_size=1, max_size=6,
+                        unique=True,
+                    ),
+                    max_size=9,
+                ),
+            ),
+            max_size=6,
+        ),
+        st.integers(1, 7),  # block width
+    )
+    @settings(max_examples=60, deadline=None)
+    def test_csr_blocks_bit_identical(self, shards, width):
+        import numpy as np
+
+        from spark_examples_tpu.arrays.blocks import (
+            blocks_from_calls,
+            blocks_from_csr,
+        )
+
+        n = 11
+
+        def pairs():
+            for sh in shards:
+                if sh is None:
+                    yield None
+                    continue
+                nonempty = [c for c in sh if c]
+                if not nonempty:
+                    yield None
+                    continue
+                offs = np.zeros(len(nonempty) + 1, dtype=np.int64)
+                for i, c in enumerate(nonempty):
+                    offs[i + 1] = offs[i] + len(c)
+                idx = np.concatenate(
+                    [np.asarray(c, dtype=np.int64) for c in nonempty]
+                )
+                yield idx, offs
+
+        # blocks_from_calls receives the SAME rows the CSR pairs carry
+        # (carrying streams drop empty variants before both tiers).
+        flat_nonempty = [c for sh in shards if sh for c in sh if c]
+        want = list(blocks_from_calls(iter(flat_nonempty), n, width))
+        got = list(blocks_from_csr(pairs(), n, width))
+        assert len(got) == len(want)
+        for a, b in zip(want, got):
+            np.testing.assert_array_equal(a, b)
