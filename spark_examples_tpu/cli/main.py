@@ -98,6 +98,7 @@ def _resolve_source(args, references: str):
 
 
 def _cmd_pca(args) -> int:
+    _enable_compile_cache()
     from spark_examples_tpu.models.pca import VariantsPcaDriver
     from spark_examples_tpu.parallel.distributed import initialize_from_env
 
@@ -192,6 +193,7 @@ def _cmd_search_variants(args, fn) -> int:
 
 
 def _cmd_reads_example(args) -> int:
+    _enable_compile_cache()
     from spark_examples_tpu.models import search_reads as sr
 
     n = args.example
@@ -279,6 +281,7 @@ def _cmd_reads_example(args) -> int:
 
 def _cmd_pca_bridge(args) -> int:
     """Serve the PcaBackend seam over TCP."""
+    _enable_compile_cache()
     from spark_examples_tpu.bridge import PcaBridgeServer, TpuPcaBackend
 
     mesh = None
@@ -427,15 +430,20 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _enable_compile_cache() -> None:
-    """Persistent XLA compile cache for every CLI run.
+    """Persistent XLA compile cache for the jit-compiling subcommands.
 
     The first ``eigh`` compile at N≈2500 is minutes through a
     remote-compile tunnel; without a persistent cache every CLI process
     pays it again (measured: the warm all-autosomes run spent 145.6 s of
     its 260.8 s total re-compiling programs the previous run had already
-    built). Default location: the source checkout's ``.jax_cache/`` when
-    the package runs from a tree that has one to anchor to (pyproject.toml
-    beside the package), else the user cache dir.
+    built). Called lazily from the handlers that actually compile (pca,
+    reads-example, pca-bridge) so host-only subcommands (generate-fixture,
+    serve-cohort, search-variants) never import jax or touch the
+    filesystem for it. Default location: the user cache dir
+    (``$XDG_CACHE_HOME``/``~/.cache``); the source checkout's
+    ``.jax_cache/`` is used only when the checkout is writable AND already
+    has one (an opt-in anchor — dev trees keep their warm cache, but a
+    read-only or pristine install never grows a side-effect directory).
     ``SPARK_EXAMPLES_TPU_COMPILE_CACHE=<path>`` overrides; ``=0``
     disables. The dir is host-feature-keyed (utils/compile_cache.py), so
     a cache populated on another host can't feed this one illegal code.
@@ -455,8 +463,9 @@ def _enable_compile_cache() -> None:
     pkg_root = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
-    if os.path.exists(os.path.join(pkg_root, "pyproject.toml")):
-        enable_persistent_cache(os.path.join(pkg_root, ".jax_cache"))
+    anchored = os.path.join(pkg_root, ".jax_cache")
+    if os.path.isdir(anchored) and os.access(anchored, os.W_OK):
+        enable_persistent_cache(anchored)
         return
     base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
         os.path.expanduser("~"), ".cache"
@@ -466,7 +475,6 @@ def _enable_compile_cache() -> None:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    _enable_compile_cache()
     return args.fn(args)
 
 

@@ -156,8 +156,29 @@ def pack_indicator_block(x_block: np.ndarray) -> np.ndarray:
     especially the axon tunnel); 0/1 indicators waste 7 of every 8 bits
     of an int8 block. ``np.packbits`` is C-speed and the pack overlaps
     the previous block's device matmul in the prefetch pipeline.
+
+    PRECONDITION: values must be 0/1 indicators. Packing collapses any
+    nonzero value to 1 (``astype(bool)``), which would silently corrupt a
+    dosage-valued block (0/1/2) into a wrong Gramian. A strided subsample
+    (≤64Ki elements, so the check never competes with packbits itself at
+    the ~160 MB bench block size) is validated on every call; it cannot
+    catch every stray value, so block producers own the full invariant.
     """
     x_block = np.asarray(x_block)
+    if x_block.size:
+        flat = x_block.reshape(-1)
+        step = max(1, flat.shape[0] // 65536)
+        sample = flat[::step]
+        # Exact-0/1 check (not a range check): a fractional dosage like
+        # 0.5 sits inside [0, 1] but still collapses to 1 under
+        # astype(bool) — compare against the round-trip instead.
+        if not np.array_equal(sample, sample.astype(bool)):
+            bad_lo, bad_hi = sample.min(), sample.max()
+            raise ValueError(
+                "pack_indicator_block requires exact 0/1 indicator values; "
+                f"got values in [{bad_lo}, {bad_hi}] (dosage-valued blocks "
+                "must use the unpacked path)"
+            )
     return np.packbits(x_block.astype(bool), axis=1)
 
 

@@ -33,6 +33,7 @@ from spark_examples_tpu.ops.centering import double_center
 from spark_examples_tpu.ops.gramian import (
     mxu_cross_product,
     pack_indicator_block,
+    resolve_gramian_compute_dtype,
     unpack_indicator_block,
 )
 from spark_examples_tpu.ops.pcoa import (
@@ -65,6 +66,9 @@ def gramian_variant_parallel(x, mesh: Mesh, compute_dtype=None):
     ``x``: (N, V) with V divisible by the data-axis size. Returns G
     replicated (N small enough to replicate in this regime).
     """
+    compute_dtype = resolve_gramian_compute_dtype(
+        x.dtype, jnp.float32, compute_dtype
+    )
 
     @partial(
         jax.shard_map,
@@ -91,6 +95,30 @@ def _axis_product(mesh: Mesh, spec: P) -> int:
 
 def _mesh_spans_processes(mesh: Mesh) -> bool:
     return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+# dtype.num ↔ dtype for the cross-process dtype agreement (allgather moves
+# int64 codes, not dtype objects); covers every block dtype a producer can
+# legitimately emit (indicators, dosages, counts).
+_DTYPE_BY_NUM = {
+    np.dtype(t).num: np.dtype(t)
+    for t in (
+        np.bool_,
+        np.int8,
+        np.uint8,
+        np.int16,
+        np.int32,
+        np.int64,
+        np.float16,
+        np.float32,
+        np.float64,
+    )
+}
+
+
+def _dtype_name(num: int):
+    dt = _DTYPE_BY_NUM.get(num)
+    return str(dt) if dt is not None else f"dtype.num={num}"
 
 
 def _accumulate_blocks(
@@ -134,15 +162,85 @@ def _accumulate_blocks(
     )
     v_div = _axis_product(mesh, P(v_spec))
 
+    # Resolve the MXU dtype policy (incl. the SPARK_EXAMPLES_TPU_GRAMIAN
+    # env escape hatch) OUTSIDE the trace, per accumulation stream —
+    # mxu_cross_product's contract. The packed path always unpacks to
+    # int8; the unpacked path resolves from the first block's REAL dtype
+    # (a float dosage block must compute in float, not truncate to int8),
+    # peeked here and pushed back onto the stream. On a process-spanning
+    # mesh the peeked dtype is AGREED cross-process (same protocol shape
+    # as the width sync): a process whose stream is empty would otherwise
+    # default to int8 while float peers compile a different executable
+    # around the same collectives — divergent programs, hang or garbage.
+    # A real dtype mismatch raises on every process simultaneously.
+    if packed:
+        x_dtype = np.dtype(np.int8)
+    else:
+        blocks = iter(blocks)
+        first = next(blocks, None)
+        x_dtype = (
+            np.dtype(np.int8) if first is None else np.asarray(first).dtype
+        )
+        if _mesh_spans_processes(mesh):
+            from jax.experimental import multihost_utils
+
+            # Raw num goes into the collective UNVALIDATED — validation
+            # happens after the gather, on identical data everywhere, so
+            # an unsupported dtype raises on every process together
+            # instead of one process erroring pre-collective while peers
+            # block in the allgather.
+            local_num = -1 if first is None else x_dtype.num
+            nums = np.asarray(
+                multihost_utils.process_allgather(
+                    np.array([local_num], np.int64)
+                )
+            ).ravel()
+            present = sorted({int(v) for v in nums if v >= 0})
+            unsupported = [n for n in present if n not in _DTYPE_BY_NUM]
+            if unsupported:
+                raise ValueError(
+                    "unsupported block dtype(s) in the pod-mode stream: "
+                    f"{[_dtype_name(n) for n in unsupported]}; supported: "
+                    f"{sorted(str(d) for d in _DTYPE_BY_NUM.values())}"
+                )
+            if len(present) > 1:
+                raise ValueError(
+                    "block dtypes differ across processes: "
+                    f"{[_dtype_name(n) for n in present]}; "
+                    "every host must stream the same block dtype"
+                )
+            if present:
+                x_dtype = _DTYPE_BY_NUM[present[0]]
+        if first is not None:
+            import itertools
+
+            blocks = itertools.chain((first,), blocks)
+    compute_dtype = resolve_gramian_compute_dtype(
+        x_dtype, accum_dtype, compute_dtype
+    )
+
     @partial(jax.jit, donate_argnums=(0,), out_shardings=g_sharding)
     def _accum(g, xb):
         if packed:
             xb = unpack_indicator_block(xb, 8 * xb.shape[1])
         return g + mxu_cross_product(xb, g.dtype, compute_dtype)
 
+    spans = _mesh_spans_processes(mesh)
+
     def padded_blocks():
         for block in blocks:
             xb = np.asarray(block)
+            # Mid-stream dtype drift would retrace _accum with the WRONG
+            # (stream-agreed) compute_dtype — e.g. float dosages truncated
+            # through an int8 executable. Catch it locally here on
+            # single-process meshes; the pod path defers to the per-step
+            # synced check so the raise is never one-sided.
+            if not packed and not spans and xb.dtype != x_dtype:
+                raise ValueError(
+                    f"block dtype changed mid-stream: {xb.dtype} after the "
+                    f"stream was resolved as {x_dtype}; every block must "
+                    "share one dtype"
+                )
             if n_padded != n_samples:
                 xb = np.pad(xb, ((0, n_padded - n_samples), (0, 0)))
             if packed:
@@ -155,8 +253,11 @@ def _accumulate_blocks(
     g = jax.device_put(
         jnp.zeros((n_padded, n_padded), dtype=accum_dtype), g_sharding
     )
-    fill_dtype = np.uint8 if packed else np.int8
-    if _mesh_spans_processes(mesh):
+    # Zero-fill for drained streams must match the agreed block dtype, or
+    # a drained float peer would feed int8 shards into the same global
+    # array its neighbours build from float32.
+    fill_dtype = np.dtype(np.uint8) if packed else x_dtype
+    if spans:
         stream = _synced_block_stream(
             padded_blocks(), n_padded, x_sharding, fill_dtype=fill_dtype
         )
@@ -216,6 +317,9 @@ def gramian_variant_parallel_ring(x, mesh: Mesh, compute_dtype=None):
     form makes the schedule explicit (and testable) as SURVEY.md §2.10's
     ring/blockwise analog.
     """
+    compute_dtype = resolve_gramian_compute_dtype(
+        x.dtype, jnp.float32, compute_dtype
+    )
     n_dev = mesh.shape[DATA_AXIS]
     perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
 
@@ -299,19 +403,43 @@ def _synced_block_stream(
     alone would leave peers deadlocked in the next collective) and an
     exhausted process zero-fills at the peers' width until all streams
     drain (zero columns are inert in the Gramian).
+
+    The same message carries each block's dtype.num: the upfront
+    agreement in ``_accumulate_blocks`` only sees FIRST blocks, so a
+    mid-stream dtype divergence (or a coordinated mid-stream switch away
+    from the dtype the executable was compiled for) must be caught per
+    step — again on every process simultaneously, from identical
+    gathered data.
     """
     from jax.experimental import multihost_utils
 
+    expected_num = fill_dtype.num
     it = iter(local_blocks)
     while True:
         block = next(it, None)
-        w = -1 if block is None else int(np.asarray(block).shape[1])
-        peer_widths = np.asarray(
-            multihost_utils.process_allgather(np.array([w], np.int64))
-        ).ravel()
-        live = sorted({int(x) for x in peer_widths if x >= 0})
+        if block is None:
+            w, num = -1, -1
+        else:
+            block = np.asarray(block)
+            w, num = int(block.shape[1]), block.dtype.num
+        peer_info = np.asarray(
+            multihost_utils.process_allgather(
+                np.array([w, num], np.int64)
+            )
+        ).reshape(-1, 2)
+        live = sorted({int(x) for x, _ in peer_info if x >= 0})
         if not live:
             return
+        bad_nums = sorted(
+            {int(n) for x, n in peer_info if x >= 0 and n != expected_num}
+        )
+        if bad_nums:
+            raise ValueError(
+                "block dtype diverged mid-stream: got "
+                f"{[_dtype_name(n) for n in bad_nums]} where the stream "
+                f"was resolved as {_dtype_name(expected_num)}; every host "
+                "must stream one dtype for the whole accumulation"
+            )
         if len(live) > 1:
             raise ValueError(
                 "block widths differ across processes in the same step: "

@@ -105,6 +105,35 @@ def test_gramian_packed_transfer_path_bit_identical():
         )
 
 
+def test_pack_indicator_block_rejects_non_indicator_values():
+    """Packing collapses any nonzero to 1; a dosage-valued (0/1/2) block
+    must be rejected loudly instead of silently producing a wrong Gramian
+    (round-3 advisor finding on the hard-coded packed default)."""
+    import numpy as np
+    import pytest
+
+    from spark_examples_tpu.ops.gramian import pack_indicator_block
+
+    ok = np.zeros((4, 16), dtype=np.int8)
+    ok[1, 3] = 1
+    pack_indicator_block(ok)  # 0/1 passes
+    bad = ok.copy()
+    bad[2, 5] = 2
+    with pytest.raises(ValueError, match="0/1 indicator"):
+        pack_indicator_block(bad)
+    neg = ok.copy()
+    neg[0, 0] = -1
+    with pytest.raises(ValueError, match="0/1 indicator"):
+        pack_indicator_block(neg)
+    # Fractional dosages sit inside [0, 1] but still collapse to 1 under
+    # astype(bool) — the guard must be an exact-0/1 check, not a range
+    # check (round-4 re-review finding).
+    frac = np.zeros((4, 16), dtype=np.float32)
+    frac[1, 2] = 0.5
+    with pytest.raises(ValueError, match="0/1 indicator"):
+        pack_indicator_block(frac)
+
+
 def test_gramian_env_escape_hatch_per_call(monkeypatch):
     """SPARK_EXAMPLES_TPU_GRAMIAN is resolved OUTSIDE jit on every call:
     flipping it after a first (cached) trace must still take effect, and

@@ -157,6 +157,18 @@ class TestReviewRegressions:
         assert g.shape == (23, 23)
         np.testing.assert_array_equal(np.asarray(g), np.asarray(gramian(x)))
 
+    def test_sharded_gramian_float_blocks_compute_in_float(self):
+        """Out-of-trace dtype resolution must key off the block's REAL
+        dtype: a fractional float block (imputed dosages) computes its
+        exact f32 product, never a silent int8 truncation (round-4
+        review finding on the resolve hoist)."""
+        mesh = make_mesh("data:4,model:2")
+        xb = np.full((8, 16), 0.5, np.float32)
+        g = sharded_gramian_blockwise([xb], 8, mesh)
+        np.testing.assert_allclose(
+            np.asarray(g), np.full((8, 8), 4.0, np.float32)
+        )
+
     def test_driver_mesh_uses_sharded_pcoa_nondivisible(self):
         from spark_examples_tpu.genomics.fixtures import (
             DEFAULT_VARIANT_SET_ID,
