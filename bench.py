@@ -1,15 +1,28 @@
 """North-star benchmark: PCoA distance+eig phase on TPU vs CPU reference.
 
 Prints ONE JSON line:
-``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}``
 
 Workload (BASELINE.md): 1000-Genomes-scale cohort — N=2504 samples,
-V=65,536 variants, ~10% carrier density — streamed through the blockwise
-Gramian + double-centering + 2-PC eigendecomposition.
+V=65,536 variants, 3 latent subpopulations (distinct allele-frequency
+profiles, ~10% mean carrier density). Population structure makes the
+top-2 eigenbasis well-separated, so coordinate parity against the f64
+MLlib-literal golden is well-defined and asserted here (a uniform-random
+cohort has a near-degenerate spectrum and no meaningful PC2 — and no
+real cohort looks like that).
 
 ``value`` is the driver-defined metric samples²·variants/sec for the TPU
-path (steady-state: compile excluded, host→device transfer included — the
-block stream is part of the phase).
+phase: host 0/1 blocks → bit-pack → host→device transfer → Gramian →
+double-centering → top-2 eigenvectors → **coordinates host-visible**.
+
+TIMING HONESTY (round-4 finding, PERFORMANCE.md "Timing honesty"):
+``block_until_ready`` is non-blocking on the axon relay platform — 6.9
+TFLOP of chained matmuls "completed" in 0.04 ms under it. Every phase
+here is therefore timed to a HOST READBACK of the result (the product
+semantics anyway: coordinates are emitted as TSV). Round 3's headline
+(0.060 s packed ⇒ 6.8e12) timed dispatch enqueue, not execution, and is
+not comparable; the honest number is lower and carried with a roofline
+proof of where the time goes.
 
 ``vs_baseline`` is the measured speedup over the reference semantics on
 CPU: the numpy per-partition dense accumulation exactly as the reference's
@@ -17,26 +30,27 @@ PySpark twin does it (``variants_pca.py:54-82``: ``matrix[ix, ix] += 1``
 per variant) plus driver-style float64 LAPACK eigendecomposition
 (``VariantsPca.scala:225-226``). The reference publishes no numbers
 (SURVEY.md §6), so the baseline is measured here, on this machine, on the
-same workload. The accumulation part is measured on a V/16 slice and scaled
-linearly (it is embarrassingly linear in V); eig is measured in full.
+same workload — accumulation and eig both **measured in full** (no slice
+scaling). A real `pyspark local[4]` anchor is impossible in this image
+(no JVM, no pip — BASELINE.md §"Why the Spark baseline is emulated").
 """
 
 import json
 import os
 import sys
 import time
+import timeit
 
 import numpy as np
 
-# Defaults are the 1000-Genomes-scale config; env overrides exist so the
-# bench logic itself can be exercised on CPU (where a 2504×65536 matmul
-# would take minutes) — the driver runs with defaults on the real chip.
 N_SAMPLES = int(os.environ.get("BENCH_SAMPLES", 2504))
 BLOCK_V = int(os.environ.get("BENCH_BLOCK_V", 8192))
 N_BLOCKS = int(os.environ.get("BENCH_BLOCKS", 8))
 N_VARIANTS = BLOCK_V * N_BLOCKS
-DENSITY = 0.1
 NUM_PC = 2
+# TPU v5 lite (v5e) single-chip peaks; used only to report MFU.
+PEAK_INT8_OPS = 394e12
+PEAK_BF16_FLOPS = 197e12
 
 
 def _log(msg):
@@ -44,13 +58,7 @@ def _log(msg):
 
 
 def _backend_guard():
-    """Fail over to CPU when the axon TPU relay is dead.
-
-    The relay can die mid-session (NOTES.md hardware incidents); without
-    this guard the first device op blocks forever and the round records no
-    benchmark at all. A CPU number with a loud stderr warning beats a
-    hang — the metric is rate-normalized either way.
-    """
+    """Fail over to CPU when the axon TPU relay is dead (NOTES.md)."""
     from spark_examples_tpu.utils.relay import cpu_failover_if_dead
 
     if cpu_failover_if_dead():
@@ -62,22 +70,56 @@ def _backend_guard():
     return False
 
 
-def make_blocks(seed=0):
+def make_cohort(seed=0):
+    """Structured cohort: 3 subpopulations, distinct allele frequencies."""
     rng = np.random.default_rng(seed)
-    return [
-        (rng.random((N_SAMPLES, BLOCK_V)) < DENSITY).astype(np.int8)
-        for _ in range(N_BLOCKS)
-    ]
+    pop = rng.integers(0, 3, N_SAMPLES)
+    base = rng.random(N_VARIANTS) * 0.12
+    shift = (
+        (rng.random((3, N_VARIANTS)) < 0.15)
+        * rng.random((3, N_VARIANTS))
+        * 0.5
+    )
+    prob = np.clip(base[None, :] + shift[pop], 0, 0.9)
+    x = (rng.random((N_SAMPLES, N_VARIANTS)) < prob).astype(np.int8)
+    return x
 
 
-def tpu_time(blocks, cpu_fallback=False):
+def _best(f, repeat=3):
+    f()  # warm (compile, caches)
+    return min(timeit.repeat(f, number=1, repeat=repeat))
+
+
+def measure_link(x_packed):
+    """Sync-latency floor and effective host→device bandwidth.
+
+    Both need a true barrier: a 1-element jitted readback. The put itself
+    is async through the relay, so bandwidth is measured as
+    (barriered put+readback time − latency floor).
+    """
+    import jax
+
+    tiny = jax.jit(lambda a: a.ravel()[:1])
+    small = np.ones((8, 8), np.float32)
+
+    def floor():
+        np.asarray(tiny(jax.device_put(small)))
+
+    t_floor = _best(floor, repeat=5)
+
+    def put():
+        np.asarray(tiny(jax.device_put(x_packed)))
+
+    t_put = _best(put, repeat=3)
+    bw = x_packed.nbytes / max(t_put - t_floor, 1e-9)
+    return t_floor, bw
+
+
+def tpu_phase_times(x, cpu_fallback=False):
+    """Honest end-to-end phase time per mode; returns dict + headline."""
     import jax
     import jax.numpy as jnp
 
-    # Persistent compilation cache: the N≈2500 eigh compile is minutes the
-    # first time; cached thereafter. The dir is keyed by host CPU features
-    # so a cache populated on a different host can't feed this one illegal
-    # instructions (see utils/compile_cache.py).
     from spark_examples_tpu.utils.compile_cache import (
         enable_persistent_cache,
     )
@@ -86,63 +128,71 @@ def tpu_time(blocks, cpu_fallback=False):
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
     )
     from spark_examples_tpu.ops import gramian_blockwise, pcoa
+    from spark_examples_tpu.ops.fused import pcoa_fused_packed
+    from spark_examples_tpu.ops.gramian import pack_indicator_block
 
-    # Four numerically-exact paths for the same computation, all measured:
-    # "packed" is the PRODUCTION DEFAULT (bit-packed host→device transfer,
-    # 8× fewer bytes, unpacked on device into the int8 integer-MXU matmul
-    # — on-chip 4.5× over the unpacked phase under host load), "auto" is
-    # the unpacked int8-MXU path, "f32" forces the f32 matmul (exact for
-    # 0/1 products below 2^24), "int8" keeps the whole accumulator int32.
-    # Report the fastest — forced via BENCH_INT8=1/0 if desired.
-    modes = {
-        "packed": dict(packed=True),
-        "auto": {},
-        "f32": dict(compute_dtype=jnp.float32),
-        "int8": dict(compute_dtype=jnp.int8, accum_dtype=jnp.int32),
-    }
-    forced = os.environ.get("BENCH_INT8")
-    if forced is not None:
-        modes = {"int8": modes["int8"]} if forced == "1" else {
-            "f32": modes["f32"]
-        }
-    elif cpu_fallback:
-        # Degraded mode: measure the production default only — keeps the
-        # fallback well under any harness timeout.
-        modes = {"packed": modes["packed"]}
+    blocks = [
+        x[:, i : i + BLOCK_V] for i in range(0, N_VARIANTS, BLOCK_V)
+    ]
 
-    best = None
-    for name, dt in modes.items():
-        _log(f"bench: compiling {name} (N={N_SAMPLES}, V={N_VARIANTS}) ...")
-        g = gramian_blockwise(blocks[:1], N_SAMPLES, **dt)
-        pcoa(g.astype(jnp.float32), NUM_PC)[0].block_until_ready()
+    def run_fused():
+        xp = pack_indicator_block(x)
+        coords, _ = pcoa_fused_packed(xp, N_VARIANTS, NUM_PC)
+        return coords  # pcoa_fused_packed returns host arrays (synced)
 
-        t0 = time.perf_counter()
-        g = gramian_blockwise(blocks, N_SAMPLES, **dt)
+    def run_stream(**kw):
+        g = gramian_blockwise(blocks, N_SAMPLES, **kw)
         coords, _ = pcoa(g.astype(jnp.float32), NUM_PC)
-        coords.block_until_ready()
-        dt_s = time.perf_counter() - t0
-        _log(f"bench: {name} steady-state {dt_s:.3f}s")
-        if best is None or dt_s < best[0]:
-            best = (dt_s, np.asarray(coords), name)
-    _log(f"bench: using {best[2]} path")
-    return best[0], best[1], sorted(modes), best[2]
+        return np.asarray(coords)  # host readback = the barrier
+
+    # "fused" is the PRODUCTION-FAST path this round introduced: ONE
+    # device_put of the bit-packed cohort + ONE dispatch (scan-unpack →
+    # integer-MXU Gramian → centering → CholeskyQR subspace eig) + ONE
+    # coordinate readback — the minimum sync shape for a latency-bound
+    # link. "stream-packed" is the blockwise streaming default (elastic /
+    # checkpointed ingest rides it); the unpacked modes document the
+    # 8×-bytes paths.
+    modes = {
+        "fused": run_fused,
+        "stream-packed": lambda: run_stream(packed=True),
+        "stream-int8": lambda: run_stream(
+            compute_dtype=jnp.int8, accum_dtype=jnp.int32
+        ),
+        "stream-f32": lambda: run_stream(compute_dtype=jnp.float32),
+    }
+    only = os.environ.get("BENCH_MODES")
+    if only:
+        keep = [m.strip() for m in only.split(",")]
+        modes = {k: v for k, v in modes.items() if k in keep}
+    elif cpu_fallback:
+        modes = {"fused": modes["fused"]}
+
+    times, coords_by_mode = {}, {}
+    for name, fn in modes.items():
+        _log(f"bench: compiling {name} (N={N_SAMPLES}, V={N_VARIANTS}) ...")
+        coords_by_mode[name] = fn()  # warm/compile
+        times[name] = _best(fn, repeat=3)
+        _log(f"bench: {name} honest steady-state {times[name]:.3f}s")
+    best_mode = min(times, key=times.get)
+    _log(f"bench: using {best_mode} path")
+    return times, best_mode, coords_by_mode[best_mode]
 
 
-def cpu_reference_time(blocks):
-    """Reference semantics on CPU: per-variant numpy accumulation
-    (variants_pca.py:67-75) + f64 centering/eig (VariantsPca.scala:198-226)."""
-    sample_idx = []
-    for b in blocks[:1]:
-        cols = b.shape[1] // 16
-        for c in range(cols):
-            sample_idx.append(np.nonzero(b[:, c])[0])
-
+def cpu_reference_time(x):
+    """Reference semantics on CPU, measured IN FULL: per-variant numpy
+    accumulation (variants_pca.py:67-75) + f64 centering/eig
+    (VariantsPca.scala:198-226)."""
+    _log(
+        f"bench: measuring CPU baseline accumulation in full "
+        f"(V={N_VARIANTS}) ..."
+    )
+    sample_idx = [np.nonzero(x[:, c])[0] for c in range(N_VARIANTS)]
     g = np.zeros((N_SAMPLES, N_SAMPLES), dtype=np.int64)
     t0 = time.perf_counter()
     for idx in sample_idx:
         g[np.ix_(idx, idx)] += 1
-    t_accum_slice = time.perf_counter() - t0
-    t_accum = t_accum_slice * (N_VARIANTS / len(sample_idx))
+    t_accum = time.perf_counter() - t0
+    _log(f"bench: baseline accumulation {t_accum:.1f}s (full)")
 
     from spark_examples_tpu.ops import mllib_principal_components_reference
 
@@ -151,28 +201,47 @@ def cpu_reference_time(blocks):
         g.astype(np.float64), NUM_PC
     )
     t_eig = time.perf_counter() - t0
+    _log(f"bench: baseline eig {t_eig:.1f}s (full)")
     return t_accum + t_eig, coords
 
 
 def main():
     fallback = _backend_guard()
-    blocks = make_blocks()
+    x = make_cohort()
     # The axon remote-compile tunnel occasionally drops a request
     # (transient INTERNAL "response body closed"); one retry covers it.
     try:
-        t_tpu, coords_tpu, modes_measured, mode_used = tpu_time(
-            blocks, cpu_fallback=fallback
-        )
+        times, mode_used, coords_tpu = tpu_phase_times(x, fallback)
     except Exception as e:  # noqa: BLE001 — retry once, then fail for real
         _log(f"bench: first attempt failed ({type(e).__name__}: {e}); retrying")
         time.sleep(10)
-        t_tpu, coords_tpu, modes_measured, mode_used = tpu_time(
-            blocks, cpu_fallback=fallback
-        )
-    t_cpu, _ = cpu_reference_time(blocks)
+        times, mode_used, coords_tpu = tpu_phase_times(x, fallback)
+    t_tpu = times[mode_used]
 
     import jax
 
+    from spark_examples_tpu.ops.gramian import pack_indicator_block
+    from spark_examples_tpu.ops.pcoa import normalize_eigvec_signs
+
+    x_packed = pack_indicator_block(x)
+    t_floor, link_bw = measure_link(x_packed)
+    _log(
+        f"bench: sync floor {t_floor * 1e3:.1f}ms, link "
+        f"{link_bw / 1e6:.0f} MB/s"
+    )
+
+    t_cpu, coords_ref = cpu_reference_time(x)
+    parity = float(
+        np.abs(
+            normalize_eigvec_signs(np.asarray(coords_tpu, np.float64))
+            - normalize_eigvec_signs(np.asarray(coords_ref, np.float64))
+        ).max()
+    )
+    _log(f"bench: parity vs f64 MLlib-literal golden {parity:.2e}")
+
+    flops = 2.0 * N_SAMPLES * N_SAMPLES * N_VARIANTS  # Gramian MACs×2
+    bytes_moved = x_packed.nbytes + N_SAMPLES * NUM_PC * 4
+    t_model = bytes_moved / link_bw + t_floor + flops / PEAK_INT8_OPS
     value = N_SAMPLES * N_SAMPLES * N_VARIANTS / t_tpu
     print(
         json.dumps(
@@ -181,19 +250,44 @@ def main():
                 "value": value,
                 "unit": "samples^2*variants/s",
                 "vs_baseline": t_cpu / t_tpu,
-                # Machine-readable provenance: a relay-dead CPU-fallback
-                # number must never be mistaken for a TPU measurement, a
-                # single-mode degraded run for a full sweep, or the
-                # slice-scaled baseline for a fully-measured one.
                 "backend": (
                     "cpu-fallback" if fallback else jax.default_backend()
                 ),
-                "modes_measured": modes_measured,
+                "modes_measured": sorted(times),
                 "mode_used": mode_used,
-                "workload": {"samples": N_SAMPLES, "variants": N_VARIANTS},
-                "baseline_accum": "slice-scaled (1 block, 1/16 of its "
-                "columns, scaled linearly to V)",
+                "mode_times_s": {k: round(v, 4) for k, v in times.items()},
+                "workload": {
+                    "samples": N_SAMPLES,
+                    "variants": N_VARIANTS,
+                    "cohort": "3-subpopulation structured, ~10% density",
+                },
+                "parity_max_abs_delta_vs_f64_golden": parity,
+                "parity_ok_1e4": parity <= 1e-4,
+                # Roofline: the phase through the axon relay is
+                # LINK-BOUND — bytes/bandwidth + one sync roundtrip
+                # dominate; device compute is ~1% of peak-time terms.
+                "roofline": {
+                    "bytes_moved": bytes_moved,
+                    "link_bw_bytes_per_s": round(link_bw),
+                    "sync_floor_s": round(t_floor, 4),
+                    "gramian_flops": flops,
+                    "peak_int8_ops_assumed": PEAK_INT8_OPS,
+                    "model_time_s": round(t_model, 4),
+                    "achieved_time_s": round(t_tpu, 4),
+                    "roofline_fraction": round(t_model / t_tpu, 3),
+                    "mfu_vs_int8_peak": round(
+                        flops / t_tpu / PEAK_INT8_OPS, 6
+                    ),
+                },
+                "timing": "host-readback barrier; block_until_ready is "
+                "non-blocking on the axon platform (round-4 finding) — "
+                "round-3 values timed dispatch enqueue and are not "
+                "comparable",
+                "baseline_accum": "measured in full",
                 "baseline_eig": "measured in full (f64 LAPACK)",
+                "baseline_spark_note": "pyspark local[4] anchor impossible "
+                "in this image (no JVM, no pip); numpy emulation follows "
+                "variants_pca.py:54-121 literally (BASELINE.md)",
             }
         )
     )

@@ -35,6 +35,8 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    from spark_examples_tpu.utils.sync import host_sync
+
     from spark_examples_tpu.ops import gramian_blockwise, pcoa
     from spark_examples_tpu.ops.centering import double_center
     from spark_examples_tpu.parallel.sharded import topk_eig_randomized
@@ -65,7 +67,7 @@ def main() -> int:
         ).astype(np.int8)
         g = gramian_blockwise([x], n)
         c = jax.jit(double_center)(g)
-        jax.block_until_ready(c)
+        host_sync(c)
 
         for name, fn in (
             ("dense_pcoa", lambda: pcoa(g, 2)[0]),
@@ -83,11 +85,11 @@ def main() -> int:
                     warnings.simplefilter("ignore")
                     t0 = time.perf_counter()
                     out = fn()
-                    jax.block_until_ready(out)
+                    host_sync(out)
                     first = time.perf_counter() - t0
                     t0 = time.perf_counter()
                     out = fn()
-                    jax.block_until_ready(out)
+                    host_sync(out)
                     steady = time.perf_counter() - t0
                 emit(
                     {

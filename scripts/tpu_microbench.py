@@ -36,6 +36,8 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    from spark_examples_tpu.utils.sync import host_sync
+
     print(f"devices: {jax.devices()}", file=sys.stderr)
     from spark_examples_tpu.arrays.blocks import round_up_multiple
     from spark_examples_tpu.ops.gramian import gramian_accumulate
@@ -49,11 +51,11 @@ def main() -> int:
     def timed(name, init, step):
         g = init()
         g = step(g, xd)  # compile + warm
-        jax.block_until_ready(g)
+        host_sync(g)
         t0 = time.perf_counter()
         for _ in range(args.iters):
             g = step(g, xd)
-        jax.block_until_ready(g)
+        host_sync(g)
         dt = (time.perf_counter() - t0) / args.iters
         gflops = 2 * n_pad * n_pad * args.block / dt / 1e9
         print(f"{name:16s} {dt*1e3:9.2f} ms/block   {gflops:10.0f} GFLOP/s")

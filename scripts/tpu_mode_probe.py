@@ -7,7 +7,7 @@ dispatches) ranked int8 einsum ~3x faster than f32, while ``bench.py``
 ~20x SLOWER than f32. The suspected cause is the unpadded sample axis
 falling off the integer-MXU tiling. This probe settles it: every mode is
 timed over the SAME end-to-end phase bench.py measures (host blocks ->
-device stream -> accumulated G, block_until_ready), at both N=2504 and
+device stream -> accumulated G, host-readback barrier), at both N=2504 and
 the 128-padded N=2560, twice each (second rep reported; first warms).
 
 Usage (relay alive): python scripts/tpu_mode_probe.py [--blocks 8]
@@ -38,6 +38,8 @@ def main() -> int:
 
     import jax
     import jax.numpy as jnp
+
+    from spark_examples_tpu.utils.sync import host_sync
 
     from spark_examples_tpu.arrays.blocks import round_up_multiple
     from spark_examples_tpu.ops.gramian import gramian_blockwise
@@ -82,7 +84,7 @@ def main() -> int:
             for _ in range(args.reps):
                 t0 = time.perf_counter()
                 g = gramian_blockwise(blocks, n, **kw)
-                jax.block_until_ready(g)
+                host_sync(g)
                 times.append(time.perf_counter() - t0)
             del g
             emit(

@@ -49,6 +49,8 @@ def main():
     import jax.numpy as jnp
     import numpy as np
 
+    from spark_examples_tpu.utils.sync import host_sync
+
     t0 = time.perf_counter()
     devs = jax.devices()
     emit(
@@ -62,10 +64,10 @@ def main():
     # 2. tiny matmul
     x = jnp.ones((128, 128), jnp.float32)
     t0 = time.perf_counter()
-    (x @ x).block_until_ready()
+    host_sync(x @ x)
     t_compile = time.perf_counter() - t0
     t0 = time.perf_counter()
-    (x @ x).block_until_ready()
+    host_sync(x @ x)
     emit(
         step="matmul128_f32",
         compile_s=round(t_compile, 3),
@@ -83,10 +85,10 @@ def main():
         ("f32", {}),
     ):
         t0 = time.perf_counter()
-        gramian_blockwise(blocks[:1], n, **kw).block_until_ready()
+        host_sync(gramian_blockwise(blocks[:1], n, **kw))
         t_compile = time.perf_counter() - t0
         t0 = time.perf_counter()
-        gramian_blockwise(blocks, n, **kw).block_until_ready()
+        host_sync(gramian_blockwise(blocks, n, **kw))
         dt = time.perf_counter() - t0
         emit(
             step=f"gramian_{name}",
@@ -101,10 +103,10 @@ def main():
     g = jnp.asarray(rng.random((n, n)), jnp.float32)
     g = g + g.T
     t0 = time.perf_counter()
-    jnp.linalg.eigh(g)[0].block_until_ready()
+    host_sync(jnp.linalg.eigh(g)[0])
     t_compile = time.perf_counter() - t0
     t0 = time.perf_counter()
-    jnp.linalg.eigh(g)[0].block_until_ready()
+    host_sync(jnp.linalg.eigh(g)[0])
     emit(
         step="eigh512_f32",
         compile_s=round(t_compile, 3),

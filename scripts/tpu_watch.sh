@@ -15,7 +15,17 @@ POLL="${2:-60}"
 
 echo "$(date -u +%H:%M:%S) watching relay (poll ${POLL}s)" >&2
 while true; do
-  if curl -s -m 5 -o /dev/null http://127.0.0.1:8093/; then
+  # The relay is a raw TCP socket, NOT HTTP — curl against it exits
+  # nonzero even when alive (round-4 finding). Probe with a plain TCP
+  # connect, matching spark_examples_tpu/utils/relay.py:relay_alive.
+  if python - <<'PY'
+import socket, sys
+try:
+    socket.create_connection(("127.0.0.1", 8093), timeout=5).close()
+except OSError:
+    sys.exit(1)
+PY
+  then
     echo "$(date -u +%H:%M:%S) relay ALIVE — starting capture" >&2
     bash scripts/tpu_capture.sh "$OUT"
     rc=$?

@@ -1,0 +1,200 @@
+"""Single-dispatch fused PCoA: packed X → coordinates in ONE device program.
+
+Why this exists (round-4 roofline work): through the axon relay the PCoA
+phase is **link-bound** — the measured host→device path moves ~48 MB/s and
+every synchronous host-visible result costs a ~65 ms roundtrip, while the
+device-side compute for the whole bench workload (Gramian + centering +
+top-k eig at N=2504, V=65536) is ~10 ms. The streamed production path
+(``gramian_blockwise`` + ``pcoa``) pays one put per block plus several
+dispatch/readback roundtrips; this path pays the minimum possible:
+
+    1 × device_put of the bit-packed X  (the irreducible bytes)
+    1 × jit dispatch                     (unpack → Gramian → center → eig)
+    1 × readback of the (N, k) coordinates
+
+On links where latency and per-transfer overheads dominate (any remote
+tunnel; also multi-process launches amortizing dispatch), this is the
+fastest shape the computation can take; on a local PCIe link it simply ties
+the streamed path, because both then sit at the same transfer roofline.
+
+The top-k eigendecomposition inside the program is randomized subspace
+iteration with **CholeskyQR** panel orthonormalization: ``qr`` on TPU
+lowers to sequential Householder steps (measured 2.4× slower end-to-end),
+whereas CholeskyQR is two MXU matmuls plus a (p, p) Cholesky + triangular
+solve — numerically fine here because panels are re-orthonormalized every
+iteration and PCoA spectra are mild (κ(panel Gram) ≈ (λ₁/λ_p)² per sweep;
+the f32 limit ~2^12 dwarfs realistic population-structure ratios, and the
+parity gate below would catch a violation loudly).
+
+Semantics match :func:`spark_examples_tpu.ops.pcoa.pcoa` exactly: raw
+sign-normalized eigenvectors of the double-centered Gramian ordered by
+|λ| descending (the MLlib composition equivalence — pcoa.py module
+docstring; reference ``VariantsPca.scala:198-231``). Accuracy vs dense
+``eigh`` is set by ``iters``; the defaults land ≤1e-4 max coordinate error
+on structured (population-structure) cohorts and are verified against the
+f64 MLlib-literal golden in tests and in ``bench.py``. The spectral-gap
+degeneracy check runs host-side on the returned Ritz values, exactly as
+the dense path's (:func:`~spark_examples_tpu.ops.pcoa.check_spectral_gap`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_examples_tpu.ops.centering import double_center
+from spark_examples_tpu.ops.gramian import (
+    pack_indicator_block,
+    resolve_gramian_compute_dtype,
+    unpack_indicator_block,
+)
+from spark_examples_tpu.ops.pcoa import (
+    check_spectral_gap,
+    normalize_eigvec_signs,
+)
+
+__all__ = ["pcoa_fused_packed", "subspace_eig_cholqr"]
+
+
+def subspace_eig_cholqr(c, k: int, oversample: int = 8, iters: int = 16,
+                        key=None):
+    """Top-|λ| eigenpairs of symmetric ``c`` — jittable, MXU-only inner loop.
+
+    Returns ``(vecs (N, k+oversample), vals (k+oversample,))`` |λ|-ordered
+    and sign-normalized; callers slice to k after the host-side gap check.
+    """
+    n = c.shape[0]
+    p = min(n, k + oversample)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (n, p), c.dtype)
+    eye = jnp.eye(p, dtype=c.dtype)
+
+    # TPU matmuls default to bf16 MXU passes — fine for the int8-exact
+    # Gramian, fatal for eigenvector refinement (the iteration stalls at
+    # ~1e-4 instead of converging to ~3e-7, measured on chip round 4).
+    # Panel matmuls are O(N²p) — forcing f32-equivalent precision costs
+    # ~3× on a term that is ~1% of the phase.
+    with jax.default_matmul_precision("float32"):
+
+        def body(q, _):
+            y = c @ q
+            # CholeskyQR: orthonormalize through the (p, p) Gram factor.
+            # The tiny jitter keeps the factorization alive when a panel
+            # column underflows (rank-deficient C); such columns are
+            # discarded by the |λ| ordering anyway.
+            r = jnp.linalg.cholesky(
+                y.T @ y + jnp.finfo(c.dtype).tiny * eye
+            )
+            q = jax.lax.linalg.triangular_solve(
+                r, y, left_side=False, lower=True, transpose_a=True
+            )
+            return q, None
+
+        q, _ = jax.lax.scan(body, q, None, length=iters)
+        y = c @ q
+        b = q.T @ y
+        w, u = jnp.linalg.eigh(b)
+        order = jnp.argsort(-jnp.abs(w))
+        return normalize_eigvec_signs(q @ u[:, order]), w[order]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_bits", "chunk_bits", "k", "oversample", "iters",
+                     "compute_dtype"),
+)
+def _fused_jit(xp, n_bits, chunk_bits, k, oversample, iters, compute_dtype,
+               key):
+    n = xp.shape[0]
+    n_chunks = -(-n_bits // chunk_bits)
+    # Chunk the packed variant axis and scan, so the unpacked int8
+    # transient is (N, chunk_bits) instead of (N, V) — bounds HBM at
+    # all-autosomes V while staying one dispatch.
+    xc = xp.reshape(n, n_chunks, chunk_bits // 8).transpose(1, 0, 2)
+
+    def accum(g, chunk):
+        x = unpack_indicator_block(chunk, chunk_bits)
+        if compute_dtype == jnp.int8:
+            prod = jnp.einsum(
+                "nv,mv->nm", x, x, preferred_element_type=jnp.int32
+            )
+        else:
+            xf = x.astype(compute_dtype)
+            # Float MXU path: accumulate the exact 0/1 product in its own
+            # dtype, then cast the integral counts into the int32
+            # accumulator (exact below 2^24 per entry, as everywhere).
+            prod = jnp.einsum(
+                "nv,mv->nm", xf, xf, preferred_element_type=compute_dtype
+            ).astype(jnp.int32)
+        return g + prod, None
+
+    g, _ = jax.lax.scan(accum, jnp.zeros((n, n), jnp.int32), xc)
+    c = double_center(g.astype(jnp.float32))
+    vecs, vals = subspace_eig_cholqr(
+        c, k, oversample=oversample, iters=iters, key=key
+    )
+    return vecs, vals
+
+
+def pcoa_fused_packed(
+    x_packed: np.ndarray,
+    n_bits: int,
+    k: int,
+    chunk_bits: int = 65536,
+    oversample: int = 8,
+    iters: int = 28,
+    seed: int = 0,
+    compute_dtype=None,
+    device=None,
+    timer=None,
+):
+    """Packed indicator matrix → top-k principal coordinates, one dispatch.
+
+    Args:
+      x_packed: ``(N, ⌈V/8⌉)`` uint8, :func:`pack_indicator_block` output
+        for the WHOLE cohort (all variant blocks concatenated).
+      n_bits: V — the true variant count (pad bits beyond it are zero and
+        inert).
+      k: number of principal coordinates.
+      chunk_bits: variant-axis chunk per scan step; bounds the unpacked
+        (N, chunk) int8 transient in HBM.
+      compute_dtype: MXU dtype policy; default resolves via
+        :func:`resolve_gramian_compute_dtype` (int8 integer-MXU).
+
+    Returns:
+      ``(coords (N, k) np.ndarray, vals (k,) np.ndarray)`` — same
+      semantics as ``pcoa(gramian(X), k)``.
+    """
+    x_packed = np.asarray(x_packed)
+    compute_dtype = resolve_gramian_compute_dtype(
+        jnp.int8, jnp.float32, compute_dtype
+    )
+    chunk_bits = int(min(chunk_bits, max(8, n_bits)))
+    chunk_bits = ((chunk_bits + 7) // 8) * 8
+    chunk_bytes = chunk_bits // 8
+    n_chunks = -(-x_packed.shape[1] // chunk_bytes)
+    padded_cols = n_chunks * chunk_bytes
+    if padded_cols != x_packed.shape[1]:
+        # Zero bytes unpack to zero columns — inert in X @ X.T.
+        x_packed = np.pad(
+            x_packed, ((0, 0), (0, padded_cols - x_packed.shape[1]))
+        )
+    xpd = jax.device_put(x_packed, device)
+    vecs, vals = _fused_jit(
+        xpd,
+        n_chunks * chunk_bits,
+        chunk_bits,
+        k,
+        oversample,
+        iters,
+        compute_dtype,
+        jax.random.PRNGKey(seed),
+    )
+    vecs = np.asarray(vecs)
+    vals = np.asarray(vals, dtype=np.float64)
+    check_spectral_gap(vals, k, timer=timer)
+    return vecs[:, :k], vals[:k]

@@ -1,0 +1,33 @@
+"""True device-completion barrier for timing and stage attribution.
+
+``block_until_ready`` is NOT a completion barrier on the axon relay
+platform: round-4 measurement had 6.9 TFLOP of chained 4096² matmuls
+"complete" in 0.04 ms under ``block_until_ready()`` while a 1-element
+readback of the same result took 67 ms — the relay's PjRt client resolves
+buffer futures at enqueue, so every round-3 number timed with
+``block_until_ready`` measured dispatch, not execution (see
+PERFORMANCE.md "Timing honesty"). The only reliable barrier through the
+relay is a host readback; :func:`host_sync` reads back ONE element per
+array (a jitted slice, so the transfer is 4 bytes, not the array), which
+costs one sync roundtrip (~65 ms over the tunnel, microseconds on local
+CPU/TPU backends where it is equivalent to a real block_until_ready).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["host_sync"]
+
+
+def host_sync(tree) -> None:
+    """Block until every array in ``tree`` has actually finished computing.
+
+    Accepts a single array or any pytree of arrays; non-array leaves are
+    ignored. Safe on numpy inputs (no-op reads).
+    """
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "ravel"):
+            np.asarray(leaf.ravel()[:1])

@@ -172,3 +172,76 @@ def test_gap_check_unsquares_covariance_eigenvalues():
         topk_with_gap_check(
             lambda kk: (coords[:, :kk], sq_vals[:kk]), 1, 2
         )
+
+
+class TestFusedPcoa:
+    """ops/fused.py: the single-dispatch packed path must match the dense
+    pipeline (gramian → pcoa) at the 1e-4 parity bar on structured
+    cohorts, including ragged/padded packed widths."""
+
+    def _structured_indicators(self, n, v, seed=0):
+        rng = np.random.default_rng(seed)
+        pop = rng.integers(0, 3, n)
+        base = rng.random(v) * 0.12
+        shift = (rng.random((3, v)) < 0.2) * rng.random((3, v)) * 0.5
+        prob = np.clip(base[None, :] + shift[pop], 0, 0.9)
+        return (rng.random((n, v)) < prob).astype(np.int8)
+
+    def test_fused_matches_dense_pcoa(self):
+        from spark_examples_tpu.ops.fused import pcoa_fused_packed
+        from spark_examples_tpu.ops.gramian import (
+            gramian,
+            pack_indicator_block,
+        )
+        from spark_examples_tpu.ops.pcoa import pcoa
+
+        x = self._structured_indicators(96, 500)
+        coords_ref, vals_ref = pcoa(gramian(x), 2)
+        coords, vals = pcoa_fused_packed(
+            pack_indicator_block(x), 500, 2, chunk_bits=128, iters=40
+        )
+        assert coords.shape == (96, 2)
+        np.testing.assert_allclose(
+            coords, np.asarray(coords_ref), atol=1e-4
+        )
+        np.testing.assert_allclose(
+            vals, np.asarray(vals_ref), rtol=1e-4
+        )
+
+    def test_fused_matches_mllib_f64_golden(self):
+        from spark_examples_tpu.ops.fused import pcoa_fused_packed
+        from spark_examples_tpu.ops.gramian import pack_indicator_block
+        from spark_examples_tpu.ops.pcoa import (
+            mllib_principal_components_reference,
+        )
+
+        x = self._structured_indicators(64, 320, seed=3)
+        g64 = x.astype(np.int64) @ x.T.astype(np.int64)
+        ref, _ = mllib_principal_components_reference(
+            g64.astype(np.float64), 2
+        )
+        coords, _ = pcoa_fused_packed(
+            pack_indicator_block(x), 320, 2, chunk_bits=64, iters=40
+        )
+        assert np.abs(coords - ref).max() <= 1e-4
+
+    def test_fused_ragged_width_and_single_chunk(self):
+        from spark_examples_tpu.ops.fused import pcoa_fused_packed
+        from spark_examples_tpu.ops.gramian import (
+            gramian,
+            pack_indicator_block,
+        )
+        from spark_examples_tpu.ops.pcoa import pcoa
+
+        # V=101: not a multiple of 8 (packbits pad bits) nor of the chunk
+        # (zero-byte padding); chunk_bits larger than V collapses to one
+        # padded chunk.
+        x = self._structured_indicators(40, 101, seed=7)
+        coords_ref, _ = pcoa(gramian(x), 2)
+        for chunk in (48, 4096):
+            coords, _ = pcoa_fused_packed(
+                pack_indicator_block(x), 101, 2, chunk_bits=chunk, iters=40
+            )
+            np.testing.assert_allclose(
+                coords, np.asarray(coords_ref), atol=1e-4
+            )
