@@ -177,7 +177,16 @@ def _accumulate_blocks(
         x_dtype = np.dtype(np.int8)
     else:
         blocks = iter(blocks)
-        first = next(blocks, None)
+        # The peek itself can raise (the producer runs ingest): on a
+        # process-spanning mesh that raise must ride the agreement
+        # collective below (code −2) like every later step's does in
+        # _synced_block_stream, or one host dies pre-collective while
+        # peers block in the allgather forever.
+        peek_exc = None
+        try:
+            first = next(blocks, None)
+        except Exception as e:  # noqa: BLE001 — re-raised below, synced
+            peek_exc, first = e, None
         x_dtype = (
             np.dtype(np.int8) if first is None else np.asarray(first).dtype
         )
@@ -189,12 +198,23 @@ def _accumulate_blocks(
             # an unsupported dtype raises on every process together
             # instead of one process erroring pre-collective while peers
             # block in the allgather.
-            local_num = -1 if first is None else x_dtype.num
+            if peek_exc is not None:
+                local_num = -2
+            else:
+                local_num = -1 if first is None else x_dtype.num
             nums = np.asarray(
                 multihost_utils.process_allgather(
                     np.array([local_num], np.int64)
                 )
             ).ravel()
+            failed = [i for i, v in enumerate(nums) if int(v) == -2]
+            if failed:
+                raise RuntimeError(
+                    "block stream failed on process(es) "
+                    f"{failed} while peeking the first block; raising "
+                    "on every process together (a one-sided raise "
+                    "would strand peers in the collective)"
+                ) from peek_exc
             present = sorted({int(v) for v in nums if v >= 0})
             unsupported = [n for n in present if n not in _DTYPE_BY_NUM]
             if unsupported:
@@ -211,6 +231,9 @@ def _accumulate_blocks(
                 )
             if present:
                 x_dtype = _DTYPE_BY_NUM[present[0]]
+        if peek_exc is not None:
+            # Single-process mesh: no peer to strand; surface directly.
+            raise peek_exc
         if first is not None:
             import itertools
 
@@ -410,28 +433,71 @@ def _synced_block_stream(
     from the dtype the executable was compiled for) must be caught per
     step — again on every process simultaneously, from identical
     gathered data.
+
+    Producer exceptions ride the same message (width code −2): the
+    upstream generator runs host-side validation (e.g.
+    ``pack_indicator_block``'s 0/1-indicator check) whose raise would
+    otherwise fire on ONE process before its allgather post, leaving
+    peers blocked in the collective forever. Instead the failing process
+    posts −2 and every process raises together, the failing one chaining
+    its original exception.
     """
     from jax.experimental import multihost_utils
 
     expected_num = fill_dtype.num
     it = iter(local_blocks)
     while True:
-        block = next(it, None)
-        if block is None:
-            w, num = -1, -1
+        exc = None
+        try:
+            block = next(it, None)
+        except Exception as e:  # noqa: BLE001 — synced below, see docstring
+            exc, block = e, None
+        if exc is not None:
+            w, num, rows = -2, -1, -1
+        elif block is None:
+            w, num, rows = -1, -1, -1
         else:
             block = np.asarray(block)
-            w, num = int(block.shape[1]), block.dtype.num
+            w, num, rows = (
+                int(block.shape[1]),
+                block.dtype.num,
+                int(block.shape[0]),
+            )
         peer_info = np.asarray(
             multihost_utils.process_allgather(
-                np.array([w, num], np.int64)
+                np.array([w, num, rows], np.int64)
             )
-        ).reshape(-1, 2)
-        live = sorted({int(x) for x, _ in peer_info if x >= 0})
+        ).reshape(-1, 3)
+        failed = [
+            i for i, (x, _, _) in enumerate(peer_info) if int(x) == -2
+        ]
+        if failed:
+            # exc is None on healthy peers — `from None` is a no-op there.
+            raise RuntimeError(
+                "block stream failed on process(es) "
+                f"{failed}; raising on every process together (a "
+                "one-sided raise would strand peers in the next "
+                "collective)"
+            ) from exc
+        # Row counts ride the same message: widths/dtypes can agree while
+        # one process's block has the wrong sample count — that would pass
+        # this sync and then diverge one-sided inside the collective
+        # accumulate (rows are the UNsharded dim, inferred from local
+        # data). n_samples here is the caller's padded N.
+        bad_rows = sorted(
+            {int(r) for x, _, r in peer_info if x >= 0 and r != n_samples}
+        )
+        if bad_rows:
+            raise ValueError(
+                f"block row counts {bad_rows} differ from the padded "
+                f"sample count {n_samples}; every host must stream "
+                "blocks over the full (padded) sample axis"
+            )
+        live = sorted({int(x) for x, _, _ in peer_info if x >= 0})
         if not live:
             return
         bad_nums = sorted(
-            {int(n) for x, n in peer_info if x >= 0 and n != expected_num}
+            {int(n) for x, n, _ in peer_info if x >= 0 and n != expected_num}
         )
         if bad_nums:
             raise ValueError(

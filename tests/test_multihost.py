@@ -428,8 +428,11 @@ _POD_CHECKPOINT_WORKER = textwrap.dedent(
         try:
             driver.get_similarity_matrix_checkpointed()
             ok = False
-        except IOError:
-            ok = True
+        except RuntimeError as e:
+            # Producer failures surface through the synced pod stream
+            # (every process raises together), chaining the original
+            # ingest error on the process(es) whose stream failed.
+            ok = isinstance(e.__cause__, IOError)
         with open(sys.argv[1] + f".phase1.{pid}", "w") as f:
             json.dump({"ok": ok}, f)
     else:
@@ -631,8 +634,10 @@ _SAMPLE_SHARDED_CHECKPOINT_WORKER = textwrap.dedent(
         try:
             driver.get_similarity_matrix_checkpointed()
             ok = False
-        except IOError:
-            ok = True
+        except RuntimeError as e:
+            # Synced pod-stream failure protocol: RuntimeError on every
+            # process, original ingest error chained on the failing one.
+            ok = isinstance(e.__cause__, IOError)
         with open(sys.argv[1] + f".phase1.{pid}", "w") as f:
             json.dump({"ok": ok}, f)
     else:
@@ -927,3 +932,78 @@ def test_process_loss_fail_stop_and_recovery(tmp_path):
     calls = plain.get_calls([plain.filter_dataset(d) for d in data])
     g_plain = np.asarray(plain.get_similarity_matrix(calls))
     np.testing.assert_array_equal(np.asarray(result["g"]), g_plain)
+
+
+_SYNCED_FAILURE_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from spark_examples_tpu.parallel.distributed import initialize_from_env
+    assert initialize_from_env()
+    from spark_examples_tpu.parallel.sharded import gramian_blockwise_global
+
+    pid = jax.process_index()
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("host", "data"))
+    rng = np.random.default_rng(7)
+    blocks = [
+        (rng.random((24, 32)) < 0.3).astype(np.int8) for _ in range(3)
+    ]
+    scenario = sys.argv[2]
+    if scenario == "packed-midstream":
+        if pid == 0:
+            # Mid-stream invariant violation: a dosage value sneaks into
+            # the 0/1 stream. pack_indicator_block's host-side check fires
+            # INSIDE the padded-blocks generator, before this process's
+            # allgather — the exact one-sided shape that used to deadlock
+            # the peer.
+            blocks[1][0, 0] = 2
+        stream, packed, expect_cause = iter(blocks), True, ValueError
+    else:  # unpacked-first-peek: the peek in _accumulate_blocks raises
+        def failing_first():
+            if pid == 0:
+                raise IOError("injected first-block ingest failure")
+            yield from blocks
+        stream, packed, expect_cause = failing_first(), False, IOError
+    try:
+        gramian_blockwise_global(stream, 24, mesh, packed=packed)
+    except RuntimeError as e:
+        ok = "block stream failed on process(es) [0]" in str(e)
+        # The failing process chains the original producer exception.
+        if pid == 0:
+            ok = ok and isinstance(e.__cause__, expect_cause)
+        else:
+            ok = ok and e.__cause__ is None
+        with open(sys.argv[1] + f".{pid}", "w") as f:
+            json.dump({"ok": ok, "err": str(e)}, f)
+        sys.exit(0 if ok else 3)
+    sys.exit(4)  # no raise at all: the invariant check silently vanished
+    """
+)
+
+
+@pytest.mark.parametrize(
+    "scenario", ["packed-midstream", "unpacked-first-peek"]
+)
+def test_producer_failure_is_synced_not_one_sided(tmp_path, scenario):
+    """A producer-side failure (non-0/1 block under packed=True, or an
+    ingest error while peeking the first block's dtype) on ONE process
+    must raise on EVERY process together — the healthy peer must not be
+    left blocked in a collective forever."""
+    script = tmp_path / "worker.py"
+    script.write_text(_SYNCED_FAILURE_WORKER)
+    out_file = tmp_path / "result.json"
+    _run_workers(
+        script,
+        [out_file, scenario],
+        env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
+        timeout=120,
+    )
+    for pid in (0, 1):
+        result = json.loads((tmp_path / f"result.json.{pid}").read_text())
+        assert result["ok"], result
