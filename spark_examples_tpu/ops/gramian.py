@@ -228,6 +228,7 @@ def gramian_blockwise(
     compute_dtype=None,
     device=None,
     packed: bool = False,
+    prepacked: bool = False,
 ):
     """Stream variant blocks through ``G += X_blk @ X_blk.T`` on device.
 
@@ -242,6 +243,9 @@ def gramian_blockwise(
         :mod:`spark_examples_tpu.arrays.blocks`).
       n_samples: N — fixed by the callset index before any variant is read
         (reference ``VariantsCommon.scala:38-50``).
+      prepacked: with ``packed=True``, the blocks are ALREADY
+        ``pack_indicator_block`` output (uint8 bytes) — skip the host
+        pack (callers that keep a packed cohort resident).
 
     Returns:
       ``(N, N)`` device Gramian.
@@ -258,7 +262,7 @@ def gramian_blockwise(
         # which are inert in X @ X.T.
         def packed_stream():
             for xb in blocks:
-                yield pack_indicator_block(xb)
+                yield xb if prepacked else pack_indicator_block(xb)
 
         for xp in device_prefetch(packed_stream(), device=device):
             g = gramian_accumulate_packed(g, xp, compute_dtype=compute_dtype)

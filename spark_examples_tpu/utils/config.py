@@ -70,6 +70,15 @@ class PcaConfig(GenomicsConfig):
     min_allele_frequency: Optional[float] = None
     num_pc: int = 2
     precise: bool = False  # host-f64 eigendecomposition (driver-side LAPACK analog)
+    # Eigendecomposition route for the PCA stage. "auto" (default) runs
+    # the fused single-dispatch finish (centering + CholeskyQR subspace
+    # eig + row sums in one program, one packed readback — ops/fused.py)
+    # on single-host unsharded runs up to --dense-eigh-limit samples and
+    # the streamed/dense route everywhere else; "fused" forces the fused
+    # finish (errors on configs it cannot serve: --precise, meshes,
+    # multi-process); "stream" forces the pre-round-5 dense/randomized
+    # route.
+    pca_mode: str = "auto"
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 64  # shards per Gramian snapshot
     # World-size-independent checkpointing (utils/elastic.py): work units
@@ -256,6 +265,16 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         default=8192,
         help="N above which eigendecomposition uses randomized subspace "
         "iteration instead of dense eigh",
+    )
+    p.add_argument(
+        "--pca-mode",
+        choices=("auto", "fused", "stream"),
+        default="auto",
+        help="PCA-stage route: 'auto' (default) runs the fused single-"
+        "dispatch finish (centering + subspace eig + row sums in one "
+        "device program, one readback) on single-host unsharded runs up "
+        "to --dense-eigh-limit samples; 'fused' forces it; 'stream' "
+        "forces the dense-eigh/randomized route",
     )
     p.add_argument(
         "--eig-tol",

@@ -183,3 +183,58 @@ def test_stream_similarity_matches_dense():
     dense = np.asarray(driver.get_similarity_matrix(iter(calls)))
     stream = np.asarray(driver.get_similarity_matrix_stream(iter(calls)))
     np.testing.assert_array_equal(dense, stream)
+
+
+class TestFusedPcaMode:
+    """--pca-mode routing and fused-vs-stream coordinate parity
+    (round-5: the fused finish is the shipped default, VariantsPca.scala's
+    main running its fast dense path, VariantsPca.scala:38-50)."""
+
+    def _structured_driver(self, mode, tmp_path=None, **kw):
+        conf = PcaConfig(
+            variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+            block_variants=64,
+            pca_mode=mode,
+            **kw,
+        )
+        # population_structure=2 gives a clean top-2 eigenbasis so the
+        # 1e-4 fused/stream parity bar is well-defined.
+        source = synthetic_cohort(
+            48, 400, population_structure=2, seed=3, references=conf.references
+        )
+        return VariantsPcaDriver(conf, source)
+
+    def test_fused_matches_stream_coordinates(self):
+        fused = self._structured_driver("fused").run()
+        stream = self._structured_driver("stream").run()
+        a = np.array([[p1, p2] for _, p1, p2 in fused])
+        b = np.array([[p1, p2] for _, p1, p2 in stream])
+        assert np.abs(a - b).max() <= 1e-4
+        assert [r[0] for r in fused] == [r[0] for r in stream]
+
+    def test_auto_routes_fused_at_small_n_and_stream_above_limit(self):
+        d = self._structured_driver("auto")
+        g = np.eye(4, dtype=np.float32)
+        assert d._pca_fused_eligible(g)
+        d_big = self._structured_driver("auto", dense_eigh_limit=8)
+        assert not d_big._pca_fused_eligible(g)  # N=48 > 8
+        d_stream = self._structured_driver("stream")
+        assert not d_stream._pca_fused_eligible(g)
+        d_precise = self._structured_driver("auto", precise=True)
+        assert not d_precise._pca_fused_eligible(g)
+
+    def test_forced_fused_rejects_incompatible_configs_before_ingest(self):
+        with pytest.raises(ValueError, match="pca-mode fused"):
+            self._structured_driver("fused", precise=True)
+
+    def test_fused_nonzero_rows_print_matches_stream(self, capsys):
+        self._structured_driver("fused").run()
+        out_fused = capsys.readouterr().out
+        self._structured_driver("stream").run()
+        out_stream = capsys.readouterr().out
+        line = [
+            l for l in out_fused.splitlines() if "Non zero rows" in l
+        ]
+        assert line and line == [
+            l for l in out_stream.splitlines() if "Non zero rows" in l
+        ]
