@@ -1,0 +1,122 @@
+// Minimal JVM PcaBackend bridge client — dependency-free Java.
+//
+// Proves the bridge protocol claim (spark_examples_tpu/bridge/backend.py:
+// newline-JSON over TCP, init/calls/finish) from the runtime the seam
+// exists for: the reference's cross-language twin is a *Spark driver on a
+// JVM* delegating the dense math through py4j
+// (src/main/python/variants_pca.py:162-182; the RDD[Seq[Int]] stage
+// boundary of VariantsPca.scala:153-168). A real Spark integration would
+// ship partitions through foreachPartition writes; the wire bytes are
+// identical to what this client sends.
+//
+// No JSON library: the protocol is line-delimited and the payload is
+// integer index lists, so requests are string literals and the single
+// response line is validated by substring checks plus a numeric parse of
+// the coordinate rows — the same discipline as the C++ twin
+// (pca_bridge_client.cpp).
+//
+// Usage: java PcaBridgeClient <port>
+//   - sends a deterministic 6-sample cohort (3 variant batches)
+//   - expects {"coords": [[...], ...], "eigvals": [...]}
+//   - exits 0 iff coords parse as 6 rows of 2 finite doubles and PC1
+//     separates samples {0,1,2} from {3,4,5}
+
+import java.io.BufferedReader;
+import java.io.InputStreamReader;
+import java.io.OutputStreamWriter;
+import java.io.Writer;
+import java.net.Socket;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.List;
+
+public final class PcaBridgeClient {
+  public static void main(String[] args) throws Exception {
+    if (args.length != 1) {
+      System.err.println("usage: java PcaBridgeClient <port>");
+      System.exit(2);
+    }
+    int port = Integer.parseInt(args[0]);
+    String resp;
+    try (Socket sock = new Socket("127.0.0.1", port)) {
+      Writer w =
+          new OutputStreamWriter(sock.getOutputStream(), StandardCharsets.UTF_8);
+      BufferedReader r =
+          new BufferedReader(
+              new InputStreamReader(sock.getInputStream(), StandardCharsets.UTF_8));
+      // Same 6-sample cohort as the C++ twin: samples {0,1,2} co-vary and
+      // {3,4,5} co-vary, so PC1 must separate the groups.
+      String[] lines = {
+        "{\"cmd\": \"init\", \"n_samples\": 6, \"num_pc\": 2}",
+        "{\"cmd\": \"calls\", \"batch\": [[0, 1, 2], [0, 1], [1, 2]]}",
+        "{\"cmd\": \"calls\", \"batch\": [[3, 4, 5], [3, 4]]}",
+        "{\"cmd\": \"calls\", \"batch\": [[4, 5], [0, 1, 2], [3, 4, 5]]}",
+        "{\"cmd\": \"finish\"}",
+      };
+      for (String line : lines) {
+        w.write(line);
+        w.write('\n');
+      }
+      w.flush();
+      resp = r.readLine();
+    }
+    if (resp == null) {
+      System.err.println("no response");
+      System.exit(1);
+    }
+    if (resp.contains("\"error\"")) {
+      System.err.println("server error: " + resp);
+      System.exit(1);
+    }
+    int coordsAt = resp.indexOf("\"coords\"");
+    int eigvalsAt = resp.indexOf("\"eigvals\"");
+    if (coordsAt < 0 || eigvalsAt < 0) {
+      System.err.println("malformed response: " + resp);
+      System.exit(1);
+    }
+    // Parse rows strictly inside the coords array ("]]" closes it), so a
+    // short row count can never be padded out by parsing into eigvals.
+    int open = resp.indexOf('[', coordsAt);
+    int coordsEnd = resp.indexOf("]]", open);
+    if (coordsEnd < 0) {
+      System.err.println("unterminated coords array");
+      System.exit(1);
+    }
+    List<double[]> rows = new ArrayList<>();
+    int cursor = open + 1;
+    while (rows.size() < 6) {
+      int rowOpen = resp.indexOf('[', cursor);
+      int rowClose = resp.indexOf(']', rowOpen + 1);
+      if (rowOpen < 0 || rowClose < 0 || rowOpen > coordsEnd) {
+        break;
+      }
+      String[] parts = resp.substring(rowOpen + 1, rowClose).split(",");
+      double[] row = new double[parts.length];
+      for (int i = 0; i < parts.length; i++) {
+        row[i] = Double.parseDouble(parts[i].trim());
+      }
+      rows.add(row);
+      cursor = rowClose + 1;
+    }
+    if (rows.size() != 6) {
+      System.err.println("expected 6 coordinate rows, got " + rows.size());
+      System.exit(1);
+    }
+    for (double[] row : rows) {
+      if (row.length != 2
+          || !Double.isFinite(row[0])
+          || !Double.isFinite(row[1])) {
+        System.err.println("bad coordinate row");
+        System.exit(1);
+      }
+    }
+    double lo = (rows.get(0)[0] + rows.get(1)[0] + rows.get(2)[0]) / 3.0;
+    double hi = (rows.get(3)[0] + rows.get(4)[0] + rows.get(5)[0]) / 3.0;
+    if ((lo > 0) == (hi > 0)) {
+      System.err.println("PC1 did not separate the two sample groups");
+      System.exit(1);
+    }
+    System.out.printf(
+        "bridge ok (jvm): 6x2 coords, group separation %.4f vs %.4f%n", lo, hi);
+  }
+}

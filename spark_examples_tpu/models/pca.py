@@ -464,7 +464,9 @@ class VariantsPcaDriver:
                 g = allreduce_gramian(g)
         return g
 
-    def get_similarity_matrix_stream(self, calls: Iterable[List[int]]):
+    def get_similarity_matrix_stream(
+        self, calls: Iterable[List[int]], max_host_bytes: int = 4 << 30
+    ):
         """Sparse pairwise alternative — getSimilarityMatrixStream parity.
 
         The reference ships an uncalled alternate that trades the dense
@@ -474,10 +476,32 @@ class VariantsPcaDriver:
         that Σk² ≪ N·V (the MXU path is otherwise strictly faster). Kept
         for API/algorithm parity; ``run()`` uses the blockwise MXU path,
         exactly as the reference's ``main`` uses the dense one.
+
+        HOST-MEMORY BOUND: unlike the device paths (G lives in HBM,
+        sample-shardable over a mesh past ``--sample-shard-threshold``),
+        this alternate accumulates a dense int64 (N, N) on the HOST. The
+        fence bounds PEAK bytes, not just the accumulator: during the
+        final conversion the int64 G (8·N²), its float32 copy (4·N²),
+        and the jax buffer (4·N²) are simultaneously alive — 16·N² total
+        (~160 GB at N=100k, the stress regime the sharded path exists
+        for). ``max_host_bytes`` (default 4 GiB, N ≈ 16k) refuses beyond
+        that instead of silently OOM-ing the host; callers with the RAM
+        opt in explicitly.
         """
         from spark_examples_tpu.arrays.blocks import _check_indices
 
         n = self.index.size
+        need = 16 * n * n  # peak: int64 G + f32 copy + jax buffer
+        if need > max_host_bytes:
+            raise ValueError(
+                f"get_similarity_matrix_stream accumulates a dense host "
+                f"int64 matrix: N={n} peaks at {need / 2**30:.1f} GiB "
+                f"(int64 G + float32 copy + jax buffer) > the "
+                f"{max_host_bytes / 2**30:.1f} GiB bound. Use the "
+                "blockwise MXU path (run()) — sample-sharded over a mesh "
+                "at this N — or pass max_host_bytes explicitly if this "
+                "host has the memory"
+            )
         g = np.zeros((n, n), dtype=np.int64)
         for sample_indices in calls:
             idx = np.asarray(sample_indices, dtype=np.int64)

@@ -238,3 +238,22 @@ class TestFusedPcaMode:
         assert line and line == [
             l for l in out_stream.splitlines() if "Non zero rows" in l
         ]
+
+
+def test_stream_similarity_host_memory_fence():
+    """The sparse alternate accumulates a dense int64 (N, N) on the HOST;
+    past the bound it must refuse loudly instead of OOM-ing silently
+    (round-5: VariantsPca.scala:248-279's alternate, fenced)."""
+    conf = PcaConfig(variant_set_ids=[DEFAULT_VARIANT_SET_ID], block_variants=32)
+    driver = VariantsPcaDriver(conf, synthetic_cohort(12, 90))
+    calls = list(driver.get_calls(driver.get_data()))
+    with pytest.raises(ValueError, match="GiB"):
+        driver.get_similarity_matrix_stream(
+            iter(calls), max_host_bytes=16 * 12 * 12 - 1
+        )
+    # At exactly the (peak: int64 G + f32 copy + jax buffer) bound it
+    # still runs.
+    out = driver.get_similarity_matrix_stream(
+        iter(calls), max_host_bytes=16 * 12 * 12
+    )
+    assert out.shape == (12, 12)
