@@ -1,0 +1,124 @@
+"""Compute-bound int8-vs-f32 Gramian re-validation (round-5, verdict #3).
+
+Round 3's "int8 = 1.8x over f32 end-to-end" was measured with the
+dispatch-enqueue methodology that round 4 invalidated; round 4's honest
+end-to-end capture showed the two indistinguishable because both were
+TRANSFER-bound (8x the packed bytes through a 47 MB/s link). This probe
+answers the question the decision log actually needs: with blocks
+DEVICE-RESIDENT (no transfer term at all), what does the MXU dtype path
+cost? Timed to a host readback barrier (utils/sync.py discipline), K
+chained accumulate steps per measurement so the per-dispatch overhead
+amortizes.
+
+Usage: python scripts/tpu_dtype_probe.py [out.jsonl]
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# Runnable as `python scripts/tpu_dtype_probe.py` without touching
+# PYTHONPATH (which carries the axon plugin site dir on TPU hosts).
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+N = 2504
+V_BLOCK = 65536
+K_STEPS = 8  # chained accumulates per timed run → V_eff = 524288
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from spark_examples_tpu.ops.gramian import (
+        pack_indicator_block,
+        unpack_indicator_block,
+        mxu_cross_product,
+    )
+
+    rng = np.random.default_rng(0)
+    # K DISTINCT blocks, stacked on the scan axis: a single reused block
+    # lets XLA hoist the loop-invariant matmul out of the scan and the
+    # "K-step" program collapses to one product (observed: every mode
+    # pinned at the sync floor). Distinct operands defeat CSE, so the
+    # timed program really performs K chained MXU products.
+    xs = (rng.random((K_STEPS, N, V_BLOCK)) < 0.1).astype(np.int8)
+    xsd = jax.device_put(xs)
+    xsp = jax.device_put(
+        np.stack([pack_indicator_block(b) for b in xs])
+    )
+
+    def timed(fn, *args):
+        out = fn(*args)  # compile
+        np.asarray(out.ravel()[:1])
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            np.asarray(out.ravel()[:1])  # host readback barrier
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    results = {}
+
+    def accum_program(block_fn):
+        @jax.jit
+        def run(stack):
+            g = jnp.zeros((N, N), jnp.float32)
+
+            def body(g, xb):
+                return g + block_fn(xb), None
+
+            g, _ = jax.lax.scan(body, g, stack)
+            return g
+
+        return run
+
+    results["int8_mxu"] = timed(
+        accum_program(lambda b: mxu_cross_product(b, jnp.float32, jnp.int8)),
+        xsd,
+    )
+    results["f32_mxu"] = timed(
+        accum_program(
+            lambda b: mxu_cross_product(b, jnp.float32, jnp.float32)
+        ),
+        xsd,
+    )
+    results["packed_unpack_int8"] = timed(
+        accum_program(
+            lambda b: mxu_cross_product(
+                unpack_indicator_block(b, V_BLOCK), jnp.float32, jnp.int8
+            )
+        ),
+        xsp,
+    )
+
+    flops = 2.0 * N * N * V_BLOCK * K_STEPS
+    record = {
+        "probe": "compute_bound_dtype",
+        "n": N,
+        "v_block": V_BLOCK,
+        "k_steps": K_STEPS,
+        "backend": jax.default_backend(),
+        "times_s": {k: round(v, 5) for k, v in results.items()},
+        "tflops": {
+            k: round(flops / v / 1e12, 1) for k, v in results.items()
+        },
+        "int8_over_f32": round(results["f32_mxu"] / results["int8_mxu"], 3),
+        "timing": "host readback barrier; device-resident operands; "
+        "K chained accumulates per dispatch",
+    }
+    line = json.dumps(record)
+    print(line)
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "a") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,7 @@ def _network_source(args):
         args.api_url,
         credentials=get_access_token(args.client_secrets),
         cache_dir=getattr(args, "cache_dir", None),
+        mirror_mode=getattr(args, "mirror_mode", "full"),
     )
 
 
@@ -313,6 +314,13 @@ def _cmd_serve_cohort(args) -> int:
             "serve-cohort needs --input-path <jsonl dir> or "
             "--fixture-samples N"
         )
+    warm = getattr(source, "ensure_serving_index", None)
+    if warm is not None:
+        # Index BEFORE accepting requests: at all-autosomes scale a lazy
+        # build on the first shard request outlives client socket
+        # timeouts (measured round 5: >60 s behind the first GET).
+        print("Indexing cohort for serving ...", flush=True)
+        print(f"Indexed {warm()} variant records.", flush=True)
     server = GenomicsServiceServer(
         source, port=args.port, token=args.token, host=args.host
     )

@@ -29,8 +29,6 @@ import json
 import os
 import sys
 import threading
-import urllib.error
-import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterator, List, Optional
 from urllib.parse import parse_qs, urlencode, urlparse
@@ -63,6 +61,16 @@ __all__ = ["GenomicsServiceServer", "HttpVariantSource"]
 # channel is out of band with respect to the data bytes.
 _DATA_PREFIX = b"d "
 _END_FRAME = b"e"
+
+
+class _ServedHttpError(Exception):
+    """Carrier for a served HTTP error status (the urllib.HTTPError
+    analog for the keep-alive http.client path): _http_code reads
+    ``.code`` off an IOError's cause regardless of transport."""
+
+    def __init__(self, code: int, reason: str):
+        super().__init__(f"HTTP {code} {reason}")
+        self.code = code
 
 
 def _http_code(exc: IOError) -> Optional[int]:
@@ -208,16 +216,27 @@ def _make_handler(source, token: Optional[str]):
                     shard = Shard(
                         q["contig"], int(q["start"]), int(q["end"])
                     )
-                    self._send_lines(
-                        json.dumps(
-                            _variant_to_record(v)
-                            if isinstance(v, Variant)
-                            else v
-                        ).encode()
-                        for v in source.stream_variants(
-                            q.get("variant_set_id", ""), shard
+                    raw = getattr(source, "stream_variant_lines", None)
+                    if raw is not None:
+                        # Zero-parse passthrough: file-backed sources
+                        # serve raw interchange lines straight off the
+                        # byte-offset index — the server never
+                        # deserializes a record (the storage-side
+                        # slicing shape of VariantsRDD.scala:205-211).
+                        self._send_lines(
+                            raw(q.get("variant_set_id", ""), shard)
                         )
-                    )
+                    else:
+                        self._send_lines(
+                            json.dumps(
+                                _variant_to_record(v)
+                                if isinstance(v, Variant)
+                                else v
+                            ).encode()
+                            for v in source.stream_variants(
+                                q.get("variant_set_id", ""), shard
+                            )
+                        )
                 elif url.path == "/reads":
                     shard = Shard(
                         q["contig"], int(q["start"]), int(q["end"])
@@ -367,40 +386,106 @@ class HttpVariantSource:
         stats: Optional[IoStats] = None,
         timeout: float = 60.0,
         cache_dir: Optional[str] = None,
+        mirror_mode: str = "full",
     ):
+        if mirror_mode not in ("full", "light"):
+            raise ValueError(
+                f"mirror_mode must be 'full' or 'light', got {mirror_mode!r}"
+            )
         self.base_url = base_url.rstrip("/")
+        self._url = urlparse(self.base_url)
         self._token = credentials.token if credentials else ""
         self.stats = stats if stats is not None else IoStats()
         self._timeout = timeout
         self._cache_dir = cache_dir
+        self._mirror_mode = mirror_mode
         self._mirror = None  # resolved lazily: JsonlSource | False | None
         # Shard-parallel ingest resolves the mirror from worker threads;
         # the download must happen exactly once, not raced.
         self._mirror_lock = threading.Lock()
+        # Keep-alive: one persistent HTTP/1.1 connection PER WORKER
+        # THREAD (an all-autosomes manifest is ~2,900 shard requests per
+        # host; a fresh TCP handshake per shard is pure overhead on real
+        # networks — reference ingest holds gRPC channels open the same
+        # way). Thread-local because http.client connections are not
+        # thread-safe; responses are fully drained by the framing layer,
+        # which is what keeps the socket reusable.
+        self._conns = threading.local()
+
+    def _connection(self):
+        conn = getattr(self._conns, "conn", None)
+        if conn is None:
+            import http.client
+
+            host = self._url.netloc
+            cls = (
+                http.client.HTTPSConnection
+                if self._url.scheme == "https"
+                else http.client.HTTPConnection
+            )
+            conn = cls(host, timeout=self._timeout)
+            self._conns.conn = conn
+        return conn
+
+    def _drop_connection(self) -> None:
+        conn = getattr(self._conns, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self._conns.conn = None
 
     def _request(self, path: str, params: dict, stream: bool = False):
-        url = f"{self.base_url}{path}?{urlencode(params)}"
-        req = urllib.request.Request(url)
+        import http.client
+
+        target = self._url.path + path
+        if params:
+            target += f"?{urlencode(params)}"
+        headers = {}
         if stream:
             # Only the framed stream endpoints decode gzip
             # (_decoded_lines); advertising it on plain-JSON paths would
             # invite a gzip-capable intermediary to encode bodies that
             # json.load reads raw.
-            req.add_header("Accept-Encoding", "gzip")
+            headers["Accept-Encoding"] = "gzip"
         if self._token:
-            req.add_header("Authorization", f"Bearer {self._token}")
+            headers["Authorization"] = f"Bearer {self._token}"
         self.stats.add(requests=1)
-        try:
-            return urllib.request.urlopen(req, timeout=self._timeout)
-        except urllib.error.HTTPError as e:
-            # A served error response (401/404/500): the reference counts
-            # these as unsuccessfulResponses (Client.scala:59).
-            self.stats.add(unsuccessful_responses=1)
-            raise IOError(f"{path}: HTTP {e.code} {e.reason}") from e
-        except urllib.error.URLError as e:
-            # No response at all — transport trouble (ioExceptions).
-            self.stats.add(io_exceptions=1)
-            raise IOError(f"{path}: {e.reason}") from e
+        for attempt in (0, 1):
+            conn = self._connection()
+            try:
+                conn.request("GET", target, headers=headers)
+                resp = conn.getresponse()
+            except (http.client.HTTPException, OSError) as e:
+                # A kept-alive socket the server closed between requests
+                # fails exactly here — reconnect once before concluding
+                # transport trouble.
+                self._drop_connection()
+                if attempt == 0:
+                    continue
+                self.stats.add(io_exceptions=1)
+                raise IOError(f"{path}: {e}") from e
+            if resp.status >= 300:
+                # A served error response (401/404/500): the reference
+                # counts these as unsuccessfulResponses (Client.scala:59).
+                # 3xx is an error too, ON PURPOSE: this client does not
+                # follow redirects (the urllib predecessor silently did),
+                # and handing a redirect body to the frame parser yields
+                # the misleading "unframed line" diagnosis — point
+                # --api-url at the service's final URL instead.
+                self.stats.add(unsuccessful_responses=1)
+                reason = resp.reason
+                code = resp.status
+                try:
+                    resp.read()  # drain so the connection stays reusable
+                except (http.client.HTTPException, OSError):
+                    self._drop_connection()
+                raise IOError(f"{path}: HTTP {code} {reason}") from (
+                    _ServedHttpError(code, reason)
+                )
+            return resp
+        raise AssertionError("unreachable")  # loop always returns/raises
 
     # -- cohort mirror cache ------------------------------------------------
 
@@ -434,9 +519,43 @@ class HttpVariantSource:
         root = os.path.join(self._cache_dir, f"cohort-{ident}")
         if not os.path.exists(os.path.join(root, MIRROR_COMPLETE_MARKER)):
             self._download_mirror(root, ident)
+        elif self._mirror_mode == "full" and not (
+            os.path.exists(os.path.join(root, "variants.jsonl"))
+            or os.path.exists(os.path.join(root, "variants.jsonl.gz"))
+        ):
+            # A LIGHT mirror from an earlier run, asked to serve full:
+            # upgrade in place by fetching the missing interchange
+            # files (atomic per file) instead of crashing the first
+            # record-streaming consumer on cache internals.
+            self._upgrade_light_mirror(root)
         from spark_examples_tpu.genomics.sources import JsonlSource
 
         return JsonlSource(root, stats=self.stats)
+
+    def _upgrade_light_mirror(self, root: str) -> None:
+        for name in ("variants.jsonl", "reads.jsonl"):
+            if os.path.exists(os.path.join(root, name)):
+                continue
+            try:
+                resp = self._request(f"/export/{name}", {}, stream=True)
+            except IOError as e:
+                if name == "reads.jsonl" and _http_code(e) == 404:
+                    continue  # reads are optional in the layout
+                raise
+            tmp = os.path.join(root, f".partial-{name}-{os.getpid()}")
+            try:
+                with open(tmp, "wb") as out:
+                    for line in self._stream_lines(
+                        resp, f"/export/{name}"
+                    ):
+                        out.write(line)
+                        out.write(b"\n")
+                os.replace(tmp, os.path.join(root, name))
+            finally:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
 
     def _download_mirror(self, root: str, ident: str) -> None:
         """Atomically populate ``root`` with the served cohort's
@@ -451,14 +570,30 @@ class HttpVariantSource:
         (fresh mtimes; possibly decompressed sizes), so the
         ``.identity``/``.sidecar-ok`` pair records that the MIRROR
         PROTOCOL vouches for it (see _CsrCohort._mirror_sidecar_trusted).
+
+        ``mirror_mode="light"`` downloads ONLY callsets.json + the
+        sidecar — at BASELINE-4 scale a ~2.7 GB npz instead of a
+        ~57.7 GB JSONL, and the only remote warm tier that fits hosts
+        with less free disk than the cohort. A light mirror serves the
+        fused/CSR ingest tiers (the default ``pca`` path end to end);
+        record-streaming consumers (--debug-datasets, search-variants)
+        need ``mirror_mode="full"``. The sidecar is then mandatory: a
+        server that cannot export one fails the mirror rather than
+        leaving a directory that can serve nothing.
         """
         import shutil
         import tempfile
 
+        light = self._mirror_mode == "light"
         os.makedirs(self._cache_dir, exist_ok=True)
         tmp = tempfile.mkdtemp(dir=self._cache_dir, prefix=".mirror-")
         try:
-            for name in ("callsets.json", "variants.jsonl", "reads.jsonl"):
+            names = (
+                ("callsets.json",)
+                if light
+                else ("callsets.json", "variants.jsonl", "reads.jsonl")
+            )
+            for name in names:
                 try:
                     resp = self._request(
                         f"/export/{name}", {}, stream=True
@@ -490,10 +625,18 @@ class HttpVariantSource:
                 ) as f:
                     f.write(ident)
             except (IOError, OSError) as e:
-                # The sidecar is a pure optimization; its failure must
-                # never destroy the mandatory JSONL mirror already on
-                # disk. A cold server may even time out here (its
-                # ensure_sidecar parses the whole cohort before
+                if light:
+                    # A light mirror WITHOUT the sidecar can serve
+                    # nothing (there is no JSONL to parse) — fail the
+                    # mirror instead of renaming a husk into place.
+                    raise IOError(
+                        "light mirror requires the server's sidecar "
+                        f"export, which failed: {e}"
+                    ) from e
+                # Otherwise the sidecar is a pure optimization; its
+                # failure must never destroy the mandatory JSONL mirror
+                # already on disk. A cold server may even time out here
+                # (its ensure_sidecar parses the whole cohort before
                 # responding) — the client then just parses locally.
                 if _http_code(e) != 404:
                     print(
@@ -623,6 +766,12 @@ class HttpVariantSource:
                         unframed = True
                         break
                     yield line[len(_DATA_PREFIX):]
+                if complete:
+                    # Drain the chunked trailer so the kept-alive
+                    # connection stays reusable for the next shard
+                    # (closing a half-read response poisons the socket
+                    # and forces a reconnect).
+                    resp.read()
         except (http.client.HTTPException, OSError, zlib.error) as e:
             self.stats.add(io_exceptions=1)
             raise IOError(f"{path}: stream aborted mid-shard: {e}") from e
@@ -663,6 +812,48 @@ class HttpVariantSource:
             self.stats,
             min_allele_frequency,
         )
+
+    def stream_carrying_csr(
+        self,
+        variant_set_id: str,
+        shard: Shard,
+        indexes: dict,
+        min_allele_frequency=None,
+    ):
+        """CSR-direct fused ingest for remote cohorts: served straight
+        off a mirrored sidecar when the cache holds one (zero network,
+        zero parse — the tier that makes warm remote all-autosomes runs
+        match local ones), else assembled from the wire's fused record
+        stream (same semantics, one (indices, offsets) pair per shard).
+        None for an empty shard window, like the local tier."""
+        import numpy as np
+
+        mirror = self._resolve_mirror()
+        if mirror:
+            return mirror.stream_carrying_csr(
+                variant_set_id, shard, indexes, min_allele_frequency
+            )
+        from spark_examples_tpu.genomics.sources import _carrying_records
+
+        # Flat accumulation, ONE array build per shard: a numpy array +
+        # concatenate node per variant would reintroduce the per-variant
+        # allocation overhead this tier exists to eliminate.
+        flat: list = []
+        lens: list = []
+        for lst in _carrying_records(
+            self._wire_variant_records(variant_set_id, shard),
+            indexes,
+            variant_set_id,
+            self.stats,
+            min_allele_frequency,
+        ):
+            flat.extend(lst)
+            lens.append(len(lst))
+        if not lens:
+            return None
+        offsets = np.zeros(len(lens) + 1, dtype=np.int64)
+        np.cumsum(np.asarray(lens, dtype=np.int64), out=offsets[1:])
+        return np.asarray(flat, dtype=np.int64), offsets
 
     def stream_carrying_keyed(
         self,

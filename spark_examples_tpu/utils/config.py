@@ -147,6 +147,17 @@ def add_genomics_flags(p: argparse.ArgumentParser) -> None:
         "cohort skip the network and hit the warm sidecar tier",
     )
     p.add_argument(
+        "--mirror-mode",
+        choices=("full", "light"),
+        default="full",
+        help="With --cache-dir: 'full' mirrors the whole interchange "
+        "cohort (every consumer works offline); 'light' downloads only "
+        "callsets + the binary CSR sidecar — at all-autosomes scale a "
+        "~2.7 GB npz instead of a ~58 GB JSONL, serving the default "
+        "fused pca ingest tiers (record-streaming consumers like "
+        "--debug-datasets need 'full')",
+    )
+    p.add_argument(
         "--input-path",
         default=None,
         help="Path to a cohort snapshot or JSONL cohort directory "

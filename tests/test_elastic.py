@@ -736,18 +736,32 @@ class TestElasticOverNetwork:
         url = f"http://127.0.0.1:{server.port}"
 
         class DiesBeforeShard(HttpVariantSource):
-            """Client whose server vanishes before the k-th shard."""
+            """Client whose server vanishes before the k-th shard.
+
+            The outage is injected on BOTH fused tiers: the driver picks
+            stream_carrying_csr when a source offers it (round 5 added
+            it to HttpVariantSource), stream_carrying otherwise.
+            """
 
             def __init__(self, url, die_at):
                 super().__init__(url)
                 self._die_at = die_at
                 self._seen = 0
 
-            def stream_carrying(self, vsid, shard, indexes, min_af):
+            def _tick(self):
                 self._seen += 1
                 if self._seen == self._die_at:
                     server.stop()  # outage mid-run
+
+            def stream_carrying(self, vsid, shard, indexes, min_af):
+                self._tick()
                 yield from super().stream_carrying(
+                    vsid, shard, indexes, min_af
+                )
+
+            def stream_carrying_csr(self, vsid, shard, indexes, min_af):
+                self._tick()
+                return super().stream_carrying_csr(
                     vsid, shard, indexes, min_af
                 )
 
