@@ -256,7 +256,9 @@ def main():
         ).max()
     )
     _log(f"bench: parity vs f64 MLlib-literal golden {parity:.2e}")
-    if parity > 1e-4 and not fallback:
+    # `not (parity <= bar)` rather than `parity > bar`: NaN coordinates
+    # must FAIL the gate, not sail through a False comparison.
+    if not (parity <= 1e-4) and not fallback:
         # A performance number with wrong coordinates is not a result.
         _log(
             "bench: FATAL — coordinate parity "

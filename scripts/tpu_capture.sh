@@ -1,8 +1,9 @@
 #!/bin/bash
 # One-shot TPU measurement capture for when the axon relay is alive.
 #
-# The relay has died mid-round twice (NOTES.md); any window of liveness
-# must yield every blocked measurement in one pass, ordered so the most
+# The relay has died mid-round three times (NOTES.md; round 5 lost it to
+# a timeout-killed client mid-dispatch); any window of liveness must
+# yield every blocked measurement in one pass, ordered so the most
 # valuable record lands first and a mid-run relay death still leaves
 # earlier results on disk. Never run concurrently with another TPU
 # process (the chip is exclusive).
@@ -23,8 +24,15 @@ mkdir -p "$OUT"
 # step would hang dialing the dead relay for its full timeout.
 if [ "${TPU_CAPTURE_FORCE:-}" = "1" ]; then
   export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
-elif ! curl -s -m 5 http://127.0.0.1:8093/ >/dev/null 2>&1; then
-  echo "relay dead (8093 unreachable); aborting" >&2
+elif ! python - <<'PY'
+import socket, sys
+try:
+    socket.create_connection(("127.0.0.1", 8093), timeout=5).close()
+except OSError:
+    sys.exit(1)
+PY
+then
+  echo "relay dead (8093 TCP refused); aborting" >&2
   exit 7
 fi
 echo "relay alive (or forced); capturing to $OUT" >&2
@@ -36,40 +44,61 @@ timeout 300 python scripts/tpu_quick_probe.py \
   >"$OUT/quick_probe.jsonl" 2>"$OUT/quick_probe.log"
 echo "quick probe rc=$? ($(wc -l <"$OUT/quick_probe.jsonl" 2>/dev/null) lines)" >&2
 
-# 1. The round's verdict-maker: bench.py on the chip (f32 + int8; the
-#    compilation cache makes the eigh compile a one-time cost).
+# 1. The round's verdict-maker: bench.py on the chip (the fused product
+#    path + the stream modes; persistent compile cache).
 timeout 1800 python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
 echo "bench rc=$? ($(tail -c 300 "$OUT/bench.json" 2>/dev/null))" >&2
 
-# 2. Gramian mode probe — THE decision instrument (end-to-end per-mode
-#    timings incl. transfer; the microbench below is ordering-only
-#    because chained dispatches overlap through the tunnel).
-timeout 1800 python scripts/tpu_mode_probe.py \
-  >"$OUT/mode_probe.jsonl" 2>"$OUT/mode_probe.log"
-echo "mode probe rc=$? ($(wc -l <"$OUT/mode_probe.jsonl" 2>/dev/null) lines)" >&2
-
-# 2b. Gramian mode table (relative ordering cross-check).
-timeout 1800 python scripts/tpu_microbench.py \
-  >"$OUT/microbench.txt" 2>"$OUT/microbench.log"
-echo "microbench rc=$?" >&2
-
-# 3. chr20-scale pipeline probe on the chip (stage split; VERDICT #7).
-#    Warm sidecar cohort if present, else in-memory fixture.
-if [ -d /tmp/cohort32k ]; then
-  SRC_ARGS="--input-path /tmp/cohort32k"
-else
-  SRC_ARGS="--fixture-samples 2504 --fixture-variants 32768 --fixture-sparse-calls"
-fi
-timeout 1800 python -m spark_examples_tpu.cli.main pca \
-  $SRC_ARGS --references 20:1:63025520 \
-  --trace-dir "$OUT/chr20_trace" \
-  --output-path "$OUT/chr20" >"$OUT/chr20_probe.txt" 2>&1
-echo "chr20 probe rc=$?" >&2
-
-# 4. The hardware-gated suite: Pallas lowering + bit-exactness, int8/f32
-#    agreement, on-chip PCoA parity vs the MLlib-semantics reference.
-timeout 1200 python -m pytest tests_tpu/ -q \
+# 2. The hardware-gated suite: every production default certified on
+#    chip (packed bit-identity, fused vs dense, randomized+adaptive eig
+#    at N=4096, sharded program, dtype agreement, PCoA parity).
+timeout 1800 python -m pytest tests_tpu/ -q \
   >"$OUT/hardware_tests.txt" 2>&1
 echo "hardware tests rc=$?" >&2
+
+# 3. Compute-bound dtype probe (round-5 decision-log instrument).
+#    stdout only — passing the path as argv too would double-write
+#    every record (the probe appends to argv[1] AND prints).
+timeout 900 python scripts/tpu_dtype_probe.py \
+  >"$OUT/dtype_probe.jsonl" 2>"$OUT/dtype_probe.log"
+echo "dtype probe rc=$?" >&2
+
+# 4. Warm local all-autosomes CLI (fused default) when the cohort is on
+#    disk — the BASELINE-4 record run.
+if [ -d /tmp/baseline4_cohort ]; then
+  timeout 1800 python -m spark_examples_tpu.cli.main pca \
+    --input-path /tmp/baseline4_cohort --all-references \
+    --output-path "$OUT/b4_local" >"$OUT/b4_local_fused.txt" 2>&1
+  echo "local all-autosomes fused rc=$?" >&2
+fi
+
+# 5. Remote tier at scale (round-5 verdict ask #4), needs the cohort
+#    service on :18719 (see NOTES.md round-5 section). Light-mirror warm
+#    first (short), then the direct streaming run (long).
+if [ -d /tmp/baseline4_cohort ] && [ -f /tmp/creds.json ]; then
+  python - <<'PY' || (nohup python -m spark_examples_tpu.cli.main serve-cohort \
+      --input-path /tmp/baseline4_cohort --port 18719 --token t \
+      >/tmp/serve_v2.log 2>&1 & sleep 300)
+import socket, sys
+try:
+    socket.create_connection(("127.0.0.1", 18719), timeout=3).close()
+except OSError:
+    sys.exit(1)
+PY
+  timeout 1800 env GENOMICS_APPLICATION_CREDENTIALS=/tmp/creds.json \
+    python -m spark_examples_tpu.cli.main pca \
+    --api-url http://127.0.0.1:18719 --all-references \
+    --cache-dir /tmp/b4cache --mirror-mode light \
+    --output-path "$OUT/b4_remote_light" \
+    >"$OUT/b4_remote_light.txt" 2>&1
+  echo "remote light-mirror rc=$?" >&2
+  timeout 3600 env GENOMICS_APPLICATION_CREDENTIALS=/tmp/creds.json \
+    python -m spark_examples_tpu.cli.main pca \
+    --api-url http://127.0.0.1:18719 --all-references \
+    --ingest-workers 8 \
+    --output-path "$OUT/b4_remote_direct" \
+    >"$OUT/b4_remote_direct.txt" 2>&1
+  echo "remote direct rc=$?" >&2
+fi
 
 echo "capture complete: $(ls "$OUT")" >&2

@@ -533,7 +533,12 @@ class HttpVariantSource:
         return JsonlSource(root, stats=self.stats)
 
     def _upgrade_light_mirror(self, root: str) -> None:
-        for name in ("variants.jsonl", "reads.jsonl"):
+        # reads BEFORE variants: the upgrade gate in _resolve_mirror_locked
+        # keys on variants.jsonl's presence, and replacing it LAST makes
+        # the gate re-fire after any interrupted upgrade — fetching
+        # variants first would mark the mirror "full" with reads.jsonl
+        # permanently missing.
+        for name in ("reads.jsonl", "variants.jsonl"):
             if os.path.exists(os.path.join(root, name)):
                 continue
             try:

@@ -702,12 +702,28 @@ class _LineIndex:
         """(contig, start) from one interchange line, or None → caller
         falls back to json.loads. Targeted scan, not a JSON parse: at
         56 KB/record the two header fields sit in the first ~100 bytes
-        and a full parse per line is ~100× the cost."""
+        and a full parse per line is ~100× the cost.
+
+        TOP-LEVEL GUARD: a key match past the record's first nested
+        container (the '[' or '{' opening calls/info/alternate_bases)
+        could be a key INSIDE a call — e.g. an info field literally
+        named "start" — and silently index the record at the wrong
+        coordinate. Any match beyond that point falls back to the real
+        parse instead.
+        """
+        nested = len(line)
+        for tok in (b"[", b"{"):
+            p = line.find(tok, 1)  # skip the record's own opening brace
+            if p >= 0:
+                nested = min(nested, p)
+        i = line.find(b'"reference_name"')
+        if i < 0 or i > nested:
+            return None
         contig = _scan_json_string(line, b'"reference_name"')
         if contig is None:
             return None
         i = line.find(b'"start"')
-        if i < 0:
+        if i < 0 or i > nested:
             return None
         i = line.find(b":", i)
         if i < 0:

@@ -1099,8 +1099,16 @@ class VariantsPcaDriver:
             # row sums), one packed readback — the minimum sync shape on
             # a latency-bound link. Row sums ride the same readback for
             # the parity print below (VariantsPca.scala:207-208).
+            # --eig-tol threads through as the convergence target (the
+            # fused path checks its own Ritz residuals and retries with
+            # doubled iterations before warning — fused_finish docstring).
+            kwargs = (
+                {"resid_warn": self.conf.eig_tol}
+                if self.conf.eig_tol is not None
+                else {}
+            )
             coords, _, row_sums = fused_finish(
-                jnp.asarray(g), self.conf.num_pc, timer=timer
+                jnp.asarray(g), self.conf.num_pc, timer=timer, **kwargs
             )
             nonzero = int((np.asarray(row_sums) > 0).sum())
             print(

@@ -179,6 +179,7 @@ def fused_finish(
     seed: int = 0,
     timer=None,
     resid_warn: float = 1e-3,
+    max_retries: int = 1,
 ):
     """(N, N) Gramian → top-k principal coordinates in ONE dispatch.
 
@@ -189,19 +190,37 @@ def fused_finish(
     ``pcoa(g, k)``; convergence and spectral-gap degeneracy are checked
     host-side on the returned values.
 
+    ``resid_warn`` is a CONVERGENCE TARGET, not just a warning bar (the
+    driver threads ``--eig-tol`` into it): when the max top-k relative
+    Ritz residual exceeds it, the sweep re-runs with doubled iterations
+    up to ``max_retries`` times (G is still device-resident, so a retry
+    is one more dispatch — rare, and only marginal-spectrum cohorts pay
+    it) before warning loudly. Eigenvector error is O(resid / gap).
+
     Returns ``(coords (N, k), vals (k,) float64, row_sums (N,))``.
     """
     n = int(g.shape[0])
     p = min(n, k + oversample)
-    out = np.asarray(
-        _finish_jit(
-            jnp.asarray(g), k, oversample, iters, jax.random.PRNGKey(seed)
+    gd = jnp.asarray(g)
+    for attempt in range(max_retries + 1):
+        run_iters = iters << attempt
+        out = np.asarray(
+            _finish_jit(
+                gd, k, oversample, run_iters, jax.random.PRNGKey(seed)
+            )
         )
-    )
+        resid = float(out[0, p + 2])
+        if np.isfinite(resid) and resid <= resid_warn:
+            break
+        if attempt < max_retries and np.isfinite(resid):
+            if timer is not None:
+                timer.note(
+                    f"fused eig residual {resid:.2e} > {resid_warn:g} "
+                    f"after {run_iters} iterations — retrying doubled"
+                )
     vecs = out[:, :p]
     row_sums = out[:, p]
     vals = out[:p, p + 1].astype(np.float64)
-    resid = float(out[0, p + 2])
     if not np.isfinite(vals).all() or not np.isfinite(resid):
         # A NaN here means the panel factorization collapsed (advisor
         # round 4: it must never flow silently into the gap check and
@@ -213,14 +232,16 @@ def fused_finish(
             "--pca-mode stream (dense eigh) or --precise"
         )
     if timer is not None:
-        timer.note(f"fused eig residual {resid:.2e} ({iters} iterations)")
+        timer.note(
+            f"fused eig residual {resid:.2e} ({run_iters} iterations)"
+        )
     if resid > resid_warn:
         warnings.warn(
             f"fused subspace iteration residual {resid:.2e} exceeds "
-            f"{resid_warn:g} after {iters} iterations — coordinates may "
-            "not have converged to dense-eigh accuracy on this cohort; "
-            "use --pca-mode stream (dense eigh) or --precise to cross-"
-            "check",
+            f"{resid_warn:g} after {run_iters} iterations — coordinates "
+            "may not have converged to dense-eigh accuracy on this "
+            "cohort; use --pca-mode stream (dense eigh) or --precise to "
+            "cross-check",
             EigResidualWarning,
             stacklevel=2,
         )

@@ -291,12 +291,13 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         "--eig-tol",
         type=float,
         default=None,
-        help="Adaptive convergence for the randomized eig path: stop "
-        "iterating once every top-k eigenpair's relative residual "
-        "|Cv - lv|/|l| drops below this (default: fixed 30-iteration "
-        "sweep); eigenvector error is then O(tol/gap). Cuts device "
-        "matmuls ~2-3x on sharp spectra; the iteration count used "
-        "appears in the stage report",
+        help="Eigensolver convergence target |Cv - lv|/|l| per top-k "
+        "pair; eigenvector error is then O(tol/gap). On the randomized "
+        "(sharded / large-N) path: adaptive early stopping (default: "
+        "fixed 30-iteration sweep), cutting device matmuls ~2-3x on "
+        "sharp spectra. On the fused path (--pca-mode auto/fused): the "
+        "residual check-and-retry bar (default 1e-3). The iteration "
+        "count used appears in the stage report",
     )
 
 

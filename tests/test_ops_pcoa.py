@@ -245,3 +245,59 @@ class TestFusedPcoa:
             np.testing.assert_allclose(
                 coords, np.asarray(coords_ref), atol=1e-4
             )
+
+
+class TestFusedFinishConvergence:
+    def test_eig_tol_retries_with_doubled_iterations(self, recwarn):
+        """resid_warn is a convergence TARGET: an under-iterated first
+        sweep must retry doubled (one extra dispatch) rather than warn
+        straight away."""
+        import jax.numpy as jnp
+
+        from spark_examples_tpu.ops.fused import (
+            EigResidualWarning,
+            fused_finish,
+        )
+        from spark_examples_tpu.ops.gramian import gramian
+        from spark_examples_tpu.ops.pcoa import pcoa
+        from spark_examples_tpu.utils.tracing import StageTimer
+
+        rng = np.random.default_rng(3)
+        pop = rng.integers(0, 3, 96)
+        base = rng.random(500) * 0.12
+        shift = (rng.random((3, 500)) < 0.2) * rng.random((3, 500)) * 0.5
+        prob = np.clip(base[None, :] + shift[pop], 0, 0.9)
+        x = (rng.random((96, 500)) < prob).astype(np.int8)
+        g = gramian(x)
+        timer = StageTimer()
+        with timer.stage("t"):
+            coords, _, _ = fused_finish(
+                jnp.asarray(g), 2, iters=8, resid_warn=1e-5, timer=timer
+            )
+        report = timer.report()
+        assert "retrying doubled" in report
+        # The retried sweep converged: no residual warning fired.
+        assert not [
+            w for w in recwarn.list if w.category is EigResidualWarning
+        ]
+        ref, _ = pcoa(jnp.asarray(g).astype(jnp.float32), 2)
+        assert np.abs(coords - np.asarray(ref)).max() <= 1e-4
+
+    def test_unconverged_after_retries_warns(self):
+        import jax.numpy as jnp
+        import pytest as _pytest
+
+        from spark_examples_tpu.ops.fused import (
+            EigResidualWarning,
+            fused_finish,
+        )
+        from spark_examples_tpu.ops.gramian import gramian
+
+        rng = np.random.default_rng(5)
+        x = (rng.random((64, 300)) < 0.2).astype(np.int8)
+        g = gramian(x)
+        with _pytest.warns(EigResidualWarning):
+            fused_finish(
+                jnp.asarray(g), 2, iters=1, resid_warn=1e-12,
+                max_retries=1,
+            )
