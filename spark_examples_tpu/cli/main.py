@@ -52,6 +52,26 @@ def _network_source(args):
     from spark_examples_tpu.genomics.auth import get_access_token
     from spark_examples_tpu.genomics.service import HttpVariantSource
 
+    if args.api_url.startswith("grpc://"):
+        # The HTTP/2 server-streaming transport (the reference's bulk
+        # channel technology, VariantsRDD.scala:26,210-211).
+        if getattr(args, "cache_dir", None):
+            # Refuse rather than silently re-stream a 57.7 GB cohort
+            # every run: the mirror/warm tier lives on the HTTP service.
+            raise SystemExit(
+                "--cache-dir/--mirror-mode are HTTP-service features "
+                "(the mirror endpoints live there); use an http:// "
+                "--api-url for cached runs, or drop --cache-dir for "
+                "direct gRPC streaming"
+            )
+        from spark_examples_tpu.genomics.grpc_transport import (
+            GrpcVariantSource,
+        )
+
+        return GrpcVariantSource(
+            args.api_url,
+            credentials=get_access_token(args.client_secrets),
+        )
     return HttpVariantSource(
         args.api_url,
         credentials=get_access_token(args.client_secrets),
@@ -321,18 +341,38 @@ def _cmd_serve_cohort(args) -> int:
         # timeouts (measured round 5: >60 s behind the first GET).
         print("Indexing cohort for serving ...", flush=True)
         print(f"Indexed {warm()} variant records.", flush=True)
-    server = GenomicsServiceServer(
-        source, port=args.port, token=args.token, host=args.host
-    )
-    print(
-        f"Genomics service listening on http://{args.host}:{server.port}"
-        + (" (token auth)" if args.token else ""),
-        flush=True,
-    )
+    grpc_server = None
+    if args.grpc_port is not None:
+        from spark_examples_tpu.genomics.grpc_transport import (
+            GrpcGenomicsServer,
+        )
+
+        grpc_server = GrpcGenomicsServer(
+            source, port=args.grpc_port, token=args.token, host=args.host
+        ).start()
+        print(
+            f"gRPC stream service on grpc://{args.host}:{grpc_server.port}"
+            + (" (token auth)" if args.token else ""),
+            flush=True,
+        )
     try:
-        server.serve_forever()
-    except KeyboardInterrupt:
-        server.stop()
+        server = GenomicsServiceServer(
+            source, port=args.port, token=args.token, host=args.host
+        )
+        print(
+            f"Genomics service listening on http://{args.host}:{server.port}"
+            + (" (token auth)" if args.token else ""),
+            flush=True,
+        )
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            server.stop()
+    finally:
+        # Covers HTTP bind failures too — a started gRPC server must
+        # never outlive the command that printed its URL.
+        if grpc_server is not None:
+            grpc_server.stop()
     return 0
 
 
@@ -426,6 +466,15 @@ def build_parser() -> argparse.ArgumentParser:
     add_pca_flags(serve)
     _add_fixture_flags(serve)
     serve.add_argument("--port", type=int, default=18718)
+    serve.add_argument(
+        "--grpc-port",
+        type=int,
+        default=None,
+        help="Also serve the gRPC/HTTP-2 server-streaming transport on "
+        "this port (0 = auto-pick; clients connect with --api-url "
+        "grpc://host:port). The HTTP service keeps the mirror/cache "
+        "endpoints; both front the same cohort",
+    )
     serve.add_argument("--host", default="127.0.0.1")
     serve.add_argument(
         "--token",

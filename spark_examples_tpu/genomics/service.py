@@ -831,34 +831,25 @@ class HttpVariantSource:
         match local ones), else assembled from the wire's fused record
         stream (same semantics, one (indices, offsets) pair per shard).
         None for an empty shard window, like the local tier."""
-        import numpy as np
-
         mirror = self._resolve_mirror()
         if mirror:
             return mirror.stream_carrying_csr(
                 variant_set_id, shard, indexes, min_allele_frequency
             )
-        from spark_examples_tpu.genomics.sources import _carrying_records
+        from spark_examples_tpu.genomics.sources import (
+            _carrying_records,
+            csr_pair_from_lists,
+        )
 
-        # Flat accumulation, ONE array build per shard: a numpy array +
-        # concatenate node per variant would reintroduce the per-variant
-        # allocation overhead this tier exists to eliminate.
-        flat: list = []
-        lens: list = []
-        for lst in _carrying_records(
-            self._wire_variant_records(variant_set_id, shard),
-            indexes,
-            variant_set_id,
-            self.stats,
-            min_allele_frequency,
-        ):
-            flat.extend(lst)
-            lens.append(len(lst))
-        if not lens:
-            return None
-        offsets = np.zeros(len(lens) + 1, dtype=np.int64)
-        np.cumsum(np.asarray(lens, dtype=np.int64), out=offsets[1:])
-        return np.asarray(flat, dtype=np.int64), offsets
+        return csr_pair_from_lists(
+            _carrying_records(
+                self._wire_variant_records(variant_set_id, shard),
+                indexes,
+                variant_set_id,
+                self.stats,
+                min_allele_frequency,
+            )
+        )
 
     def stream_carrying_keyed(
         self,

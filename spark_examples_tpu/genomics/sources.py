@@ -881,6 +881,28 @@ def _scan_json_string(line: bytes, key: bytes):
     return line[i + 1 : j].decode("utf-8", "strict")
 
 
+def csr_pair_from_lists(lists) -> Optional[tuple]:
+    """Per-variant index lists → ONE ``(indices, offsets)`` CSR pair.
+
+    The shard-assembly step shared by every wire-fed CSR tier (HTTP and
+    gRPC transports): flat accumulation with a single array build per
+    shard — a numpy array + concatenate node per variant would
+    reintroduce the per-variant allocation overhead the CSR tier exists
+    to eliminate. None for an empty shard window, matching the local
+    sidecar tier's contract.
+    """
+    flat: list = []
+    lens: list = []
+    for lst in lists:
+        flat.extend(lst)
+        lens.append(len(lst))
+    if not lens:
+        return None
+    offsets = np.zeros(len(lens) + 1, dtype=np.int64)
+    np.cumsum(np.asarray(lens, dtype=np.int64), out=offsets[1:])
+    return np.asarray(flat, dtype=np.int64), offsets
+
+
 def _line_vsid_matches(line: bytes, variant_set_id: str) -> bool:
     """The one variant-set rule (see _carrying_records) applied to a raw
     interchange line: falsy stored id is a wildcard, non-empty must
