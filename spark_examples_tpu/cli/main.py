@@ -66,8 +66,15 @@ def _network_source(args):
             )
         from spark_examples_tpu.genomics.grpc_transport import (
             GrpcVariantSource,
+            grpc_available,
         )
 
+        if not grpc_available():
+            raise SystemExit(
+                "grpc:// transport needs grpcio (pip install "
+                "'spark_examples_tpu[grpc]'); the http:// transport "
+                "has no extra dependency"
+            )
         return GrpcVariantSource(
             args.api_url,
             credentials=get_access_token(args.client_secrets),
@@ -345,8 +352,14 @@ def _cmd_serve_cohort(args) -> int:
     if args.grpc_port is not None:
         from spark_examples_tpu.genomics.grpc_transport import (
             GrpcGenomicsServer,
+            grpc_available,
         )
 
+        if not grpc_available():
+            raise SystemExit(
+                "--grpc-port needs grpcio (pip install "
+                "'spark_examples_tpu[grpc]'); omit it to serve HTTP only"
+            )
         grpc_server = GrpcGenomicsServer(
             source, port=args.grpc_port, token=args.token, host=args.host
         ).start()

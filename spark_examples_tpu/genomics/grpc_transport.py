@@ -136,6 +136,20 @@ class GrpcGenomicsServer:
             futures.ThreadPoolExecutor(max_workers=16),
             interceptors=interceptors,
             compression=grpc.Compression.Gzip,
+            options=[
+                # Tolerate the client's 30 s keepalive pings during
+                # stalled streams: the default ping-strike policy (min
+                # 300 s between data-less pings, 2 strikes) GOAWAYs the
+                # whole multiplexed connection in exactly the
+                # slow-shard scenario keepalive exists to survive
+                # (reproduced in review: 'too_many_pings' after ~3
+                # pings of stall).
+                (
+                    "grpc.http2.min_ping_interval_without_data_ms",
+                    25_000,
+                ),
+                ("grpc.http2.max_ping_strikes", 0),
+            ],
         )
         handlers = {
             "StreamVariants": grpc.unary_stream_rpc_method_handler(
@@ -296,8 +310,17 @@ class GrpcVariantSource:
     def _count_rpc_error(self, e) -> None:
         import grpc
 
-        if e.code() == grpc.StatusCode.UNAVAILABLE:
-            self.stats.add(io_exceptions=1)  # transport, not served
+        # Transport/client-local failures (nothing was SERVED: dead or
+        # wedged peer, deadline, local cancellation) are ioExceptions;
+        # everything else is a served error status — the same
+        # served-vs-transport split the HTTP source applies
+        # (Client.scala:57-61 accumulator semantics).
+        if e.code() in (
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+            grpc.StatusCode.CANCELLED,
+        ):
+            self.stats.add(io_exceptions=1)
         else:
             self.stats.add(unsuccessful_responses=1)
 

@@ -73,11 +73,38 @@ class TestGrpcStreamParity:
                 local.stream_reads("", shard)
             )
 
-    def test_callsets_and_identity(self, grpc_cohort, tmp_path):
+    def test_callsets_and_identity(self, grpc_cohort):
         src, rpc = grpc_cohort
         assert rpc.list_callsets(DEFAULT_VARIANT_SET_ID) == (
             src.list_callsets(DEFAULT_VARIANT_SET_ID)
         )
+        # Identity parity (the mirror cache key); fixtures expose one.
+        assert rpc.cohort_identity() == src.cohort_identity()
+        assert rpc.cohort_identity() is not None
+
+    def test_identity_less_source_yields_none(self):
+        inner = synthetic_cohort(4, 10, seed=1)
+
+        class NoIdentity:
+            def list_callsets(self, vsid):
+                return inner.list_callsets(vsid)
+
+            def stream_variants(self, vsid, shard):
+                return inner.stream_variants(vsid, shard)
+
+            def stream_reads(self, rgsid, shard):
+                return inner.stream_reads(rgsid, shard)
+
+        server = GrpcGenomicsServer(NoIdentity()).start()
+        client = GrpcVariantSource(f"grpc://127.0.0.1:{server.port}")
+        try:
+            # Served NOT_FOUND → None (degrade like the HTTP client),
+            # counted as a served non-OK status.
+            assert client.cohort_identity() is None
+            assert client.stats.unsuccessful_responses == 1
+        finally:
+            client.close()
+            server.stop()
 
     def test_jsonl_backed_server_takes_raw_line_path(self, tmp_path):
         """A jsonl-backed gRPC server streams raw bytes off the line
