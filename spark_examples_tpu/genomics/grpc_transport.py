@@ -138,16 +138,14 @@ class GrpcGenomicsServer:
             compression=grpc.Compression.Gzip,
             options=[
                 # Tolerate the client's 30 s keepalive pings during
-                # stalled streams: the default ping-strike policy (min
-                # 300 s between data-less pings, 2 strikes) GOAWAYs the
-                # whole multiplexed connection in exactly the
+                # stalled streams: the default ping-strike policy (2
+                # strikes, min 300 s between data-less pings) GOAWAYs
+                # the whole multiplexed connection in exactly the
                 # slow-shard scenario keepalive exists to survive
                 # (reproduced in review: 'too_many_pings' after ~3
-                # pings of stall).
-                (
-                    "grpc.http2.min_ping_interval_without_data_ms",
-                    25_000,
-                ),
+                # pings of stall). Strikes disabled outright — with 0
+                # strikes the min-interval knob would be inert, so it
+                # is not set.
                 ("grpc.http2.max_ping_strikes", 0),
             ],
         )

@@ -298,7 +298,7 @@ def test_global_mesh_gramian_two_processes(tmp_path):
     )
 
 
-_HTTP_INGEST_WORKER = textwrap.dedent(
+_NETWORK_INGEST_WORKER = textwrap.dedent(
     """
     import json, os, sys
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -309,7 +309,6 @@ _HTTP_INGEST_WORKER = textwrap.dedent(
     from spark_examples_tpu.parallel.distributed import initialize_from_env
     assert initialize_from_env()
     from spark_examples_tpu.genomics.fixtures import DEFAULT_VARIANT_SET_ID
-    from spark_examples_tpu.genomics.service import HttpVariantSource
     from spark_examples_tpu.models.pca import VariantsPcaDriver
     from spark_examples_tpu.utils.config import PcaConfig
 
@@ -321,8 +320,16 @@ _HTTP_INGEST_WORKER = textwrap.dedent(
     )
     # Every process ingests ITS manifest slice from the shared service —
     # the reference's deployment shape (each executor streams its shards
-    # from the API, VariantsRDD.scala:205-235).
-    source = HttpVariantSource(sys.argv[2])
+    # from the API over its own channel, VariantsRDD.scala:205-235) —
+    # on whichever transport argv selects.
+    if sys.argv[3] == "grpc":
+        from spark_examples_tpu.genomics.grpc_transport import (
+            GrpcVariantSource,
+        )
+        source = GrpcVariantSource(sys.argv[2])
+    else:
+        from spark_examples_tpu.genomics.service import HttpVariantSource
+        source = HttpVariantSource(sys.argv[2])
     result = VariantsPcaDriver(conf, source).run()
     if pid == 0:
         with open(sys.argv[1], "w") as f:
@@ -334,26 +341,44 @@ _HTTP_INGEST_WORKER = textwrap.dedent(
 )
 
 
-def test_two_process_http_ingest(tmp_path):
-    """DP across hosts with NETWORK ingest: two processes each stream
-    their manifest slice from one served cohort and the merged result
-    equals the single-process run over the same service."""
+@pytest.mark.parametrize("transport", ["http", "grpc"])
+def test_two_process_network_ingest(tmp_path, transport):
+    """DP across hosts with NETWORK ingest on BOTH transports: two
+    processes each stream their manifest slice from one served cohort
+    (HTTP/1.1 framed streams or gRPC/HTTP-2 server streams) and the
+    merged result equals the single-process run over the same service."""
     from spark_examples_tpu.genomics.fixtures import (
         DEFAULT_VARIANT_SET_ID,
         synthetic_cohort,
     )
-    from spark_examples_tpu.genomics.service import (
-        GenomicsServiceServer,
-        HttpVariantSource,
-    )
 
-    server = GenomicsServiceServer(synthetic_cohort(10, 80, seed=5)).start()
-    url = f"http://127.0.0.1:{server.port}"
+    cohort = synthetic_cohort(10, 80, seed=5)
+    if transport == "grpc":
+        from spark_examples_tpu.genomics.grpc_transport import (
+            GrpcGenomicsServer,
+            GrpcVariantSource,
+            grpc_available,
+        )
+
+        if not grpc_available():
+            pytest.skip("grpcio not installed")
+        server = GrpcGenomicsServer(cohort).start()
+        url = f"grpc://127.0.0.1:{server.port}"
+        make_client = lambda: GrpcVariantSource(url)  # noqa: E731
+    else:
+        from spark_examples_tpu.genomics.service import (
+            GenomicsServiceServer,
+            HttpVariantSource,
+        )
+
+        server = GenomicsServiceServer(cohort).start()
+        url = f"http://127.0.0.1:{server.port}"
+        make_client = lambda: HttpVariantSource(url)  # noqa: E731
     try:
         script = tmp_path / "worker.py"
-        script.write_text(_HTTP_INGEST_WORKER)
+        script.write_text(_NETWORK_INGEST_WORKER)
         out_file = tmp_path / "result.json"
-        _run_workers(script, [out_file, url])
+        _run_workers(script, [out_file, url, transport])
         result = json.loads(out_file.read_text())
 
         from spark_examples_tpu.models.pca import VariantsPcaDriver
@@ -364,7 +389,7 @@ def test_two_process_http_ingest(tmp_path):
             bases_per_partition=20_000,
             block_variants=32,
         )
-        single = VariantsPcaDriver(conf, HttpVariantSource(url)).run()
+        single = VariantsPcaDriver(conf, make_client()).run()
         np.testing.assert_allclose(
             np.array(
                 [r[1:] for r in result["driver_result"]], dtype=float
