@@ -24,7 +24,29 @@ from typing import Iterable, List, Optional, Protocol, Sequence
 
 import numpy as np
 
-__all__ = ["PcaBackend", "TpuPcaBackend", "PcaBridgeServer", "PcaBridgeClient"]
+__all__ = [
+    "PcaBackend",
+    "TpuPcaBackend",
+    "PcaBridgeServer",
+    "PcaBridgeClient",
+    "iter_call_batches",
+]
+
+
+def iter_call_batches(
+    calls: Iterable[Sequence[int]], batch_size: int
+) -> Iterable[List[List[int]]]:
+    """Group per-variant index lists into client-side wire batches —
+    the one batching rule both bridge clients (newline-JSON TCP and
+    gRPC ComputePca) share, so flush semantics can never diverge."""
+    batch: List[List[int]] = []
+    for c in calls:
+        batch.append([int(i) for i in c])
+        if len(batch) >= batch_size:
+            yield batch
+            batch = []
+    if batch:
+        yield batch
 
 
 class PcaBackend(Protocol):
@@ -159,13 +181,7 @@ class PcaBridgeClient:
         batch_size: int = 4096,
     ):
         self._send({"cmd": "init", "n_samples": n_samples, "num_pc": num_pc})
-        batch: List[List[int]] = []
-        for c in calls:
-            batch.append([int(i) for i in c])
-            if len(batch) >= batch_size:
-                self._send({"cmd": "calls", "batch": batch})
-                batch = []
-        if batch:
+        for batch in iter_call_batches(calls, batch_size):
             self._send({"cmd": "calls", "batch": batch})
         self._send({"cmd": "finish"})
         resp = json.loads(self._file.readline())

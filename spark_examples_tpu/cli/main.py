@@ -360,8 +360,29 @@ def _cmd_serve_cohort(args) -> int:
                 "--grpc-port needs grpcio (pip install "
                 "'spark_examples_tpu[grpc]'); omit it to serve HTTP only"
             )
+        from spark_examples_tpu.bridge.backend import TpuPcaBackend
+
+        # The gRPC endpoint also exposes the ComputePca dense-math seam
+        # (SURVEY §7.6's "small gRPC service"): external drivers stream
+        # call lists and get coordinates back from THIS host's
+        # accelerator — so the endpoint honors the same mesh/block flags
+        # and compile cache pca-bridge does. TpuPcaBackend imports jax
+        # lazily; the cache env setup is env-only, so serving stays
+        # host-only until a ComputePca call actually arrives.
+        _enable_compile_cache()
+        mesh = None
+        if args.mesh_shape:
+            from spark_examples_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(args.mesh_shape)
         grpc_server = GrpcGenomicsServer(
-            source, port=args.grpc_port, token=args.token, host=args.host
+            source,
+            port=args.grpc_port,
+            token=args.token,
+            host=args.host,
+            pca_backend=TpuPcaBackend(
+                mesh=mesh, block_variants=args.block_variants
+            ),
         ).start()
         print(
             f"gRPC stream service on grpc://{args.host}:{grpc_server.port}"
@@ -507,9 +528,10 @@ def _enable_compile_cache() -> None:
     pays it again (measured: the warm all-autosomes run spent 145.6 s of
     its 260.8 s total re-compiling programs the previous run had already
     built). Called lazily from the handlers that actually compile (pca,
-    reads-example, pca-bridge) so host-only subcommands (generate-fixture,
-    serve-cohort, search-variants) never import jax or touch the
-    filesystem for it. Default location: the user cache dir
+    reads-example, pca-bridge, and serve-cohort WITH --grpc-port — its
+    ComputePca seam jit-compiles on demand) so host-only subcommands
+    (generate-fixture, plain serve-cohort, search-variants) never import
+    jax or touch the filesystem for it. Default location: the user cache dir
     (``$XDG_CACHE_HOME``/``~/.cache``); the source checkout's
     ``.jax_cache/`` is used only when the checkout is writable AND already
     has one (an opt-in anchor — dev trees keep their warm cache, but a

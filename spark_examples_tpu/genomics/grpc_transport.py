@@ -124,11 +124,19 @@ class GrpcGenomicsServer:
         port: int = 0,
         token: Optional[str] = None,
         host: str = "127.0.0.1",
+        pca_backend=None,
     ):
+        """``pca_backend`` (optional, any
+        :class:`~spark_examples_tpu.bridge.backend.PcaBackend`) also
+        registers ``ComputePca`` — the dense-math seam as a
+        client-streaming RPC (SURVEY §7.6's "small gRPC service":
+        stream in per-variant sample-index lists, return PCs), the gRPC
+        twin of the newline-JSON ``PcaBridgeServer``."""
         import grpc
         from concurrent import futures
 
         self._source = source
+        self._pca_backend = pca_backend
         interceptors = (
             [_AuthInterceptor(token)] if token is not None else []
         )
@@ -163,6 +171,10 @@ class GrpcGenomicsServer:
                 self._identity_rpc, _identity, _identity
             ),
         }
+        if pca_backend is not None:
+            handlers["ComputePca"] = grpc.stream_unary_rpc_method_handler(
+                self._compute_pca, _identity, _identity
+            )
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
         )
@@ -232,6 +244,48 @@ class GrpcGenomicsServer:
                 grpc.StatusCode.NOT_FOUND, "source has no identity"
             )
         return json.dumps({"identity": ident}).encode()
+
+    def _compute_pca(self, request_iterator, context) -> bytes:
+        """Client-streaming PcaBackend seam (SURVEY §7.6): first message
+        ``{"n_samples": N, "num_pc": k}``, then any number of
+        ``[[sample indices...], ...]`` batch messages; reply is
+        ``{"coords": ..., "eigvals": ...}`` — the same message shapes as
+        the newline-JSON bridge, carried as HTTP/2 stream frames."""
+        import grpc
+        import numpy as np
+
+        it = iter(request_iterator)
+        try:
+            header = json.loads(next(it))
+        except StopIteration:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "empty ComputePca stream"
+            )
+
+        def rows():
+            # Lazy: backend.compute → blocks_from_calls consumes one
+            # block at a time, so the server never holds the whole call
+            # stream in RAM (an all-autosomes driver ships millions of
+            # per-variant lists).
+            for msg in it:
+                yield from json.loads(msg)
+
+        try:
+            coords, eigvals = self._pca_backend.compute(
+                rows(),
+                int(header["n_samples"]),
+                int(header["num_pc"]),
+            )
+        except (ValueError, KeyError) as e:
+            # Validation failures travel back as a status, exactly as
+            # the newline-JSON bridge replies {"error": ...}.
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        return json.dumps(
+            {
+                "coords": np.asarray(coords).tolist(),
+                "eigvals": np.asarray(eigvals).tolist(),
+            }
+        ).encode()
 
 
 class GrpcVariantSource:
@@ -347,6 +401,44 @@ class GrpcVariantSource:
             raise IOError(
                 f"{method}: {e.code().name}: {e.details()}"
             ) from e
+
+    def compute_pca(
+        self, calls, n_samples: int, num_pc: int, batch_size: int = 4096
+    ):
+        """Dense-math seam over gRPC (SURVEY §7.6): stream per-variant
+        sample-index lists, get principal coordinates back — the role
+        the reference's JVM driver plays through py4j
+        (variants_pca.py:162-182), with the same batch shapes as
+        :class:`~spark_examples_tpu.bridge.backend.PcaBridgeClient`."""
+        import grpc
+        import numpy as np
+
+        fn = self._channel.stream_unary(
+            f"/{_SERVICE}/ComputePca",
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+
+        from spark_examples_tpu.bridge.backend import iter_call_batches
+
+        def messages():
+            yield json.dumps(
+                {"n_samples": n_samples, "num_pc": num_pc}
+            ).encode()
+            for batch in iter_call_batches(calls, batch_size):
+                yield json.dumps(batch).encode()
+
+        self.stats.add(requests=1)
+        try:
+            resp = json.loads(
+                fn(messages(), metadata=self._metadata())
+            )
+        except grpc.RpcError as e:
+            self._count_rpc_error(e)
+            raise IOError(
+                f"ComputePca: {e.code().name}: {e.details()}"
+            ) from e
+        return np.asarray(resp["coords"]), np.asarray(resp["eigvals"])
 
     # -- metadata ------------------------------------------------------------
 

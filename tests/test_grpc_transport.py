@@ -221,3 +221,50 @@ class TestGrpcPipeline:
             np.array([r[1:] for r in local]),
             atol=1e-5,
         )
+
+
+class TestGrpcPcaBackendSeam:
+    def test_compute_pca_matches_tcp_bridge(self):
+        """The dense-math seam over gRPC (SURVEY §7.6's 'small gRPC
+        service') returns the same coordinates as the newline-JSON TCP
+        bridge for the same call stream."""
+        from spark_examples_tpu.bridge.backend import (
+            PcaBridgeClient,
+            PcaBridgeServer,
+            TpuPcaBackend,
+        )
+
+        calls = [[0, 1, 2], [0, 1], [1, 2], [3, 4, 5], [3, 4], [4, 5],
+                 [0, 1, 2], [3, 4, 5]]
+        backend = TpuPcaBackend(block_variants=16)
+        grpc_server = GrpcGenomicsServer(
+            synthetic_cohort(4, 10, seed=1), pca_backend=backend
+        ).start()
+        tcp_server = PcaBridgeServer(TpuPcaBackend(block_variants=16)).start()
+        rpc = GrpcVariantSource(f"grpc://127.0.0.1:{grpc_server.port}")
+        tcp = PcaBridgeClient(port=tcp_server.port)
+        try:
+            got_c, got_v = rpc.compute_pca(iter(calls), 6, 2, batch_size=3)
+            want_c, want_v = tcp.compute(iter(calls), 6, 2, batch_size=3)
+            np.testing.assert_allclose(got_c, want_c, atol=1e-6)
+            np.testing.assert_allclose(got_v, want_v, atol=1e-6)
+        finally:
+            tcp.close()
+            rpc.close()
+            grpc_server.stop()
+            tcp_server.stop()
+
+    def test_compute_pca_validation_error_is_status(self):
+        from spark_examples_tpu.bridge.backend import TpuPcaBackend
+
+        server = GrpcGenomicsServer(
+            synthetic_cohort(4, 10, seed=1),
+            pca_backend=TpuPcaBackend(block_variants=16),
+        ).start()
+        client = GrpcVariantSource(f"grpc://127.0.0.1:{server.port}")
+        try:
+            with pytest.raises(IOError, match="INVALID_ARGUMENT"):
+                client.compute_pca(iter([[0, 1]]), 6, 0)  # num_pc < 1
+        finally:
+            client.close()
+            server.stop()
