@@ -185,11 +185,15 @@ def tpu_phase_times(x, cpu_fallback=False):
     elif cpu_fallback:
         modes = {"fused": modes["fused"]}
 
+    from spark_examples_tpu import obs
+
     times, coords_by_mode = {}, {}
     for name, fn in modes.items():
         _log(f"bench: compiling {name} (N={N_SAMPLES}, V={N_VARIANTS}) ...")
-        coords_by_mode[name] = fn()  # warm/compile
-        times[name] = _best(fn, repeat=3)
+        with obs.span(f"warm:{name}"):
+            coords_by_mode[name] = fn()  # warm/compile
+        with obs.span(f"steady:{name}"):
+            times[name] = _best(fn, repeat=3)
         _log(f"bench: {name} honest steady-state {times[name]:.3f}s")
     best_mode = min(times, key=times.get)
     _log(f"bench: using {best_mode} path")
@@ -224,7 +228,37 @@ def cpu_reference_time(x):
 
 
 def main():
+    from spark_examples_tpu import obs
+    from spark_examples_tpu.obs.session import TelemetrySession
+
+    # The bench always collects its own telemetry (the per-stage
+    # breakdown rides in the output JSON); files are written only when
+    # the BENCH_*_OUT env vars ask for them. Per-kernel AOT compile/cost
+    # recording is an EXTRA compilation inside the timed warm phase, so
+    # it runs only when artifacts were explicitly requested — default
+    # bench warm numbers stay comparable with pre-telemetry rounds.
+    outs = {
+        "trace_out": os.environ.get("BENCH_TRACE_OUT") or None,
+        "metrics_out": os.environ.get("BENCH_METRICS_OUT") or None,
+        "manifest_out": os.environ.get("BENCH_MANIFEST_OUT") or None,
+    }
+    with TelemetrySession(
+        **outs,
+        xla_cost=any(outs.values()),
+        command="bench",
+        config={
+            "samples": N_SAMPLES,
+            "block_v": BLOCK_V,
+            "blocks": N_BLOCKS,
+        },
+    ) as session:
+        _bench_body(session)
+
+
+def _bench_body(session):
     fallback = _backend_guard()
+    from spark_examples_tpu import obs
+
     x = make_cohort()
     # The axon remote-compile tunnel occasionally drops a request
     # (transient INTERNAL "response body closed"); one retry covers it.
@@ -242,13 +276,15 @@ def main():
     from spark_examples_tpu.ops.pcoa import normalize_eigvec_signs
 
     x_packed = pack_indicator_block(x)
-    t_floor, link_bw = measure_link(x_packed)
+    with obs.span("measure_link"):
+        t_floor, link_bw = measure_link(x_packed)
     _log(
         f"bench: sync floor {t_floor * 1e3:.1f}ms, link "
         f"{link_bw / 1e6:.0f} MB/s"
     )
 
-    t_cpu, coords_ref = cpu_reference_time(x)
+    with obs.span("cpu_baseline"):
+        t_cpu, coords_ref = cpu_reference_time(x)
     parity = float(
         np.abs(
             normalize_eigvec_signs(np.asarray(coords_tpu, np.float64))
@@ -284,6 +320,15 @@ def main():
                 "modes_measured": sorted(times),
                 "mode_used": mode_used,
                 "mode_times_s": {k: round(v, 4) for k, v in times.items()},
+                # Per-stage wall-clock decomposition from the telemetry
+                # tracer (warm vs steady per mode, link probe, CPU
+                # baseline) — BENCH rounds diff stages, not one number.
+                "stages": {
+                    k: round(v, 4)
+                    for k, v in sorted(
+                        session.tracer.stage_seconds().items()
+                    )
+                },
                 "product_invocation": {
                     k: PRODUCT_INVOCATION[k] for k in sorted(times)
                 },

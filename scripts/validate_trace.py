@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Schema-check telemetry artifacts: trace JSON, metrics dump, manifest.
+
+Malformed telemetry must fail FAST — a trace Perfetto silently refuses
+to load, or a manifest a later tooling round can't parse, is worse than
+none because nobody notices until the artifact is needed. This script is
+both a CLI (CI/operators) and an importable library (the tier-1 tests
+call the ``validate_*`` functions directly on every pipeline-emitted
+artifact).
+
+Usage::
+
+    python scripts/validate_trace.py --trace run.trace.json \
+        --metrics run.metrics.prom --manifest run.manifest.json
+
+Each flag is optional; exit status is non-zero if ANY given file fails,
+with one line per problem on stderr.
+
+No dependencies beyond the standard library — runs anywhere, including
+images without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Any, Dict, List
+
+__all__ = [
+    "validate_trace",
+    "validate_metrics",
+    "validate_manifest",
+    "main",
+]
+
+# Chrome trace-event phases this system emits.
+_KNOWN_PHASES = {"X", "B", "E", "i", "I", "C", "M"}
+
+# Prometheus exposition line shapes (text format 0.0.4).
+_PROM_COMMENT = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ?.*$")
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"[-+]?([0-9.eE+-]+|[Nn]a[Nn]|[+-]?[Ii]nf)$"
+)
+
+MANIFEST_SCHEMA = "spark_examples_tpu.run_manifest/v1"
+_MANIFEST_REQUIRED = (
+    "schema",
+    "created_unix",
+    "config",
+    "environment",
+    "stages",
+    "counters",
+    "histograms",
+)
+
+
+def _load_json(path: str, errors: List[str]) -> Any:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        errors.append(f"{path}: not readable JSON: {e}")
+        return None
+
+
+def validate_trace(path: str) -> List[str]:
+    """Errors for a Chrome-trace-event JSON file ([] = valid)."""
+    errors: List[str] = []
+    doc = _load_json(path, errors)
+    if doc is None:
+        return errors
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        return [f"{path}: expected object with 'traceEvents'"]
+    events = doc["traceEvents"]
+    if not isinstance(events, list) or not events:
+        return [f"{path}: traceEvents must be a non-empty list"]
+    for i, ev in enumerate(events):
+        where = f"{path}: traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _KNOWN_PHASES:
+            errors.append(f"{where}: unknown phase {ph!r}")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            errors.append(f"{where}: missing/empty name")
+        if not isinstance(ev.get("pid"), int):
+            errors.append(f"{where}: pid must be an int")
+        if ph != "M":
+            if not isinstance(ev.get("ts"), (int, float)):
+                errors.append(f"{where}: ts must be a number")
+            elif ev["ts"] < 0:
+                errors.append(f"{where}: negative ts")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"{where}: complete event needs dur >= 0")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            errors.append(f"{where}: args must be an object")
+    return errors
+
+
+def validate_metrics(path: str) -> List[str]:
+    """Errors for a Prometheus text exposition file ([] = valid)."""
+    errors: List[str] = []
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        return [f"{path}: unreadable: {e}"]
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if not lines:
+        return [f"{path}: empty exposition"]
+    samples = 0
+    for lineno, line in enumerate(lines, 1):
+        if line.startswith("#"):
+            if not _PROM_COMMENT.match(line):
+                errors.append(
+                    f"{path}:{lineno}: malformed comment line: {line!r}"
+                )
+            continue
+        if not _PROM_SAMPLE.match(line):
+            errors.append(
+                f"{path}:{lineno}: malformed sample line: {line!r}"
+            )
+            continue
+        samples += 1
+    if samples == 0:
+        errors.append(f"{path}: no metric samples")
+    return errors
+
+
+def validate_manifest(path: str) -> List[str]:
+    """Errors for a run-manifest JSON file ([] = valid)."""
+    errors: List[str] = []
+    doc = _load_json(path, errors)
+    if doc is None:
+        return errors
+    if not isinstance(doc, dict):
+        return [f"{path}: manifest must be a JSON object"]
+    for key in _MANIFEST_REQUIRED:
+        if key not in doc:
+            errors.append(f"{path}: missing required key {key!r}")
+    if errors:
+        return errors
+    if doc["schema"] != MANIFEST_SCHEMA:
+        errors.append(
+            f"{path}: schema {doc['schema']!r} != {MANIFEST_SCHEMA!r}"
+        )
+    if not isinstance(doc["created_unix"], (int, float)):
+        errors.append(f"{path}: created_unix must be a number")
+    stages = doc["stages"]
+    if not isinstance(stages, dict):
+        errors.append(f"{path}: stages must be an object")
+    else:
+        for name, st in stages.items():
+            if (
+                not isinstance(st, dict)
+                or not isinstance(st.get("seconds"), (int, float))
+                or st["seconds"] < 0
+                or not isinstance(st.get("count"), int)
+            ):
+                errors.append(
+                    f"{path}: stages[{name!r}] needs seconds >= 0 and "
+                    "an int count"
+                )
+    for section in ("counters", "gauges"):
+        block = doc.get(section, {})
+        if not isinstance(block, dict):
+            errors.append(f"{path}: {section} must be an object")
+            continue
+        for key, value in block.items():
+            if not isinstance(value, (int, float)):
+                errors.append(
+                    f"{path}: {section}[{key!r}] must be numeric"
+                )
+    hists = doc["histograms"]
+    if not isinstance(hists, dict):
+        errors.append(f"{path}: histograms must be an object")
+    else:
+        for key, summary in hists.items():
+            if not isinstance(summary, dict):
+                errors.append(
+                    f"{path}: histograms[{key!r}] must be an object"
+                )
+                continue
+            for field in ("count", "sum", "mean", "p50", "p90", "p99"):
+                if not isinstance(summary.get(field), (int, float)):
+                    errors.append(
+                        f"{path}: histograms[{key!r}] missing numeric "
+                        f"{field!r}"
+                    )
+    return errors
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Schema-check telemetry artifacts"
+    )
+    p.add_argument("--trace", default=None, help="Chrome trace JSON")
+    p.add_argument(
+        "--metrics", default=None, help="Prometheus text exposition"
+    )
+    p.add_argument("--manifest", default=None, help="Run manifest JSON")
+    args = p.parse_args(argv)
+    if not (args.trace or args.metrics or args.manifest):
+        p.error("nothing to validate: pass --trace/--metrics/--manifest")
+    errors: List[str] = []
+    checked: Dict[str, int] = {}
+    if args.trace:
+        errs = validate_trace(args.trace)
+        checked[args.trace] = len(errs)
+        errors.extend(errs)
+    if args.metrics:
+        errs = validate_metrics(args.metrics)
+        checked[args.metrics] = len(errs)
+        errors.extend(errs)
+    if args.manifest:
+        errs = validate_manifest(args.manifest)
+        checked[args.manifest] = len(errs)
+        errors.extend(errs)
+    for err in errors:
+        print(err, file=sys.stderr)
+    for path, n in checked.items():
+        print(f"{path}: {'OK' if n == 0 else f'{n} problem(s)'}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -567,7 +567,25 @@ def _enable_compile_cache() -> None:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    return args.fn(args)
+    outs = {
+        name: getattr(args, name, None)
+        for name in ("trace_out", "metrics_out", "manifest_out")
+    }
+    if not any(outs.values()):
+        return args.fn(args)
+    # One telemetry session per CLI run: spans/metrics collected by the
+    # ambient helpers everywhere below, artifacts written on exit — on
+    # the failure path too, so a crashed run leaves its timeline behind.
+    # (build_manifest drops non-JSON-serializable config values itself.)
+    from spark_examples_tpu.obs import telemetry_session
+
+    config = {
+        k: v for k, v in sorted(vars(args).items()) if k != "fn"
+    }
+    with telemetry_session(
+        command=args.command, config=config, **outs
+    ):
+        return args.fn(args)
 
 
 if __name__ == "__main__":

@@ -341,6 +341,8 @@ class GrpcVariantSource:
     def _unary(self, method: str, request: dict) -> bytes:
         import grpc
 
+        from spark_examples_tpu.obs import rpc_timer
+
         fn = self._channel.unary_unary(
             f"/{_SERVICE}/{method}",
             request_serializer=_identity,
@@ -348,11 +350,12 @@ class GrpcVariantSource:
         )
         self.stats.add(requests=1)
         try:
-            return fn(
-                json.dumps(request).encode(),
-                metadata=self._metadata(),
-                timeout=self._timeout,
-            )
+            with rpc_timer("grpc", method):
+                return fn(
+                    json.dumps(request).encode(),
+                    metadata=self._metadata(),
+                    timeout=self._timeout,
+                )
         except grpc.RpcError as e:
             self._count_rpc_error(e)
             raise IOError(
@@ -379,6 +382,8 @@ class GrpcVariantSource:
     def _stream(self, method: str, request: dict) -> Iterator[bytes]:
         import grpc
 
+        from spark_examples_tpu.obs import rpc_timer
+
         fn = self._channel.unary_stream(
             f"/{_SERVICE}/{method}",
             request_serializer=_identity,
@@ -388,11 +393,14 @@ class GrpcVariantSource:
         try:
             # No deadline on streams (see __init__): liveness comes from
             # channel keepalive, so a slow-but-flowing shard never dies
-            # at an arbitrary total-wall-clock cutoff.
-            yield from fn(
-                json.dumps(request).encode(),
-                metadata=self._metadata(),
-            )
+            # at an arbitrary total-wall-clock cutoff. The latency
+            # histogram times the WHOLE stream (call → exhaustion): the
+            # per-shard decomposition stall diagnosis needs.
+            with rpc_timer("grpc", method):
+                yield from fn(
+                    json.dumps(request).encode(),
+                    metadata=self._metadata(),
+                )
         except grpc.RpcError as e:
             # Includes mid-stream aborts: gRPC's framing makes a broken
             # stream a STATUS, never a silent truncation — the property
@@ -428,11 +436,14 @@ class GrpcVariantSource:
             for batch in iter_call_batches(calls, batch_size):
                 yield json.dumps(batch).encode()
 
+        from spark_examples_tpu.obs import rpc_timer
+
         self.stats.add(requests=1)
         try:
-            resp = json.loads(
-                fn(messages(), metadata=self._metadata())
-            )
+            with rpc_timer("grpc", "ComputePca"):
+                resp = json.loads(
+                    fn(messages(), metadata=self._metadata())
+                )
         except grpc.RpcError as e:
             self._count_rpc_error(e)
             raise IOError(

@@ -438,6 +438,9 @@ class HttpVariantSource:
 
     def _request(self, path: str, params: dict, stream: bool = False):
         import http.client
+        import time as _time
+
+        from spark_examples_tpu import obs
 
         target = self._url.path + path
         if params:
@@ -453,6 +456,10 @@ class HttpVariantSource:
             headers["Authorization"] = f"Bearer {self._token}"
         self.stats.add(requests=1)
         for attempt in (0, 1):
+            # Per-ATTEMPT latency samples: one observation = one wire
+            # round-trip, the same unit the gRPC tier records, so the
+            # transports' histograms compare like for like.
+            t0 = _time.perf_counter()
             conn = self._connection()
             try:
                 conn.request("GET", target, headers=headers)
@@ -462,7 +469,14 @@ class HttpVariantSource:
                 # fails exactly here — reconnect once before concluding
                 # transport trouble.
                 self._drop_connection()
+                obs.observe_rpc(
+                    "http", path, _time.perf_counter() - t0, error=True
+                )
                 if attempt == 0:
+                    obs.count_retry("http", path)
+                    obs.instant(
+                        "http_reconnect_retry", path=path, error=repr(e)
+                    )
                     continue
                 self.stats.add(io_exceptions=1)
                 raise IOError(f"{path}: {e}") from e
@@ -481,9 +495,15 @@ class HttpVariantSource:
                     resp.read()  # drain so the connection stays reusable
                 except (http.client.HTTPException, OSError):
                     self._drop_connection()
+                obs.observe_rpc(
+                    "http", path, _time.perf_counter() - t0, error=True
+                )
                 raise IOError(f"{path}: HTTP {code} {reason}") from (
                     _ServedHttpError(code, reason)
                 )
+            # Header-phase latency: the time to a served response. Shard
+            # stream *bodies* are timed by the callers that consume them.
+            obs.observe_rpc("http", path, _time.perf_counter() - t0)
             return resp
         raise AssertionError("unreachable")  # loop always returns/raises
 

@@ -425,6 +425,8 @@ class FixtureSource:
     def _shard_items(self, shard: Shard) -> list:
         """Stats/fault-injection/index preamble shared by both variant
         streaming paths."""
+        from spark_examples_tpu import obs
+
         self.stats.add(
             partitions=1, requests=1, reference_bases=shard.range
         )
@@ -433,12 +435,17 @@ class FixtureSource:
             self.stats.add(io_exceptions=1)
             raise IOError(f"injected stream failure for {shard}")
         if self._variant_idx is None:
+            # One-time whole-cohort index build: its own span, NOT a
+            # latency sample — folding it into the first shard's
+            # histogram observation would fake a stalled-shard outlier.
             with self._idx_lock:
                 if self._variant_idx is None:
-                    self._variant_idx = _SortedIndex.build(
-                        self._variants, self._variant_key
-                    )
-        return self._variant_idx.slice(shard)
+                    with obs.span("fixture_index_build"):
+                        self._variant_idx = _SortedIndex.build(
+                            self._variants, self._variant_key
+                        )
+        with obs.rpc_timer("fixture", "StreamVariants"):
+            return self._variant_idx.slice(shard)
 
     def _built(self, items, variant_set_id: str) -> Iterator[Variant]:
         """item (dict | Variant) → Variant, applying the variant-set
@@ -1728,14 +1735,19 @@ class JsonlSource:
         """Fused fast path over the persistent columnar sidecar (built on
         first use, reused across shards, runs, and processes — see
         :class:`_CsrCohort`)."""
+        from spark_examples_tpu.obs import rpc_timer
+
         self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
-        yield from self._ensure_csr().carrying(
-            shard,
-            indexes,
-            variant_set_id,
-            self.stats,
-            min_allele_frequency,
-        )
+        # Timed to exhaustion: the per-shard extraction latency is the
+        # ingest-side decomposition the stall diagnosis needs.
+        with rpc_timer("jsonl", "stream_carrying"):
+            yield from self._ensure_csr().carrying(
+                shard,
+                indexes,
+                variant_set_id,
+                self.stats,
+                min_allele_frequency,
+            )
 
     def stream_carrying_csr(
         self,
@@ -1750,14 +1762,17 @@ class JsonlSource:
         ~85% of warm host wall-clock at all-autosomes scale). Identical
         row/stats/AF/KeyError semantics to :meth:`stream_carrying`;
         returns None for an empty shard window."""
+        from spark_examples_tpu.obs import rpc_timer
+
         self.stats.add(partitions=1, requests=1, reference_bases=shard.range)
-        return self._ensure_csr().carrying_csr(
-            shard,
-            indexes,
-            variant_set_id,
-            self.stats,
-            min_allele_frequency,
-        )
+        with rpc_timer("jsonl", "stream_carrying_csr"):
+            return self._ensure_csr().carrying_csr(
+                shard,
+                indexes,
+                variant_set_id,
+                self.stats,
+                min_allele_frequency,
+            )
 
     def stream_carrying_keyed(
         self,

@@ -253,6 +253,11 @@ class VariantsPcaDriver:
                 "(duplicate attempt launched).",
                 file=sys.stderr,
             )
+            from spark_examples_tpu import obs
+
+            obs.instant(
+                "speculative_shard_attempt", scope="p", shard=str(shard)
+            )
 
         for calls in ordered_parallel_map(
             extract,
@@ -1243,6 +1248,20 @@ class VariantsPcaDriver:
             stats = allreduce_host_stats(stats)
             if not is_coordinator():
                 return
+        # The job-end driver-merged totals (cross-host after the
+        # all-reduce above) — the authoritative Spark-accumulator-merge
+        # analog — recorded as registry gauges so the run manifest
+        # carries them distinctly from the per-instance collector sums.
+        from spark_examples_tpu import obs
+        from spark_examples_tpu.utils.stats import COUNTER_FIELDS
+
+        reg = obs.get_registry()
+        for name, value in zip(COUNTER_FIELDS, stats.as_vector()):
+            reg.gauge(
+                f"genomics_io_merged_{name}",
+                "Driver-merged job-end IoStats totals "
+                "(allreduce_host_stats across hosts)",
+            ).set(float(value))
         print(stats.report())
 
     def stop(self) -> None:

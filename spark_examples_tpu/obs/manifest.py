@@ -1,0 +1,124 @@
+"""Run manifest: one machine-readable JSON artifact per run.
+
+The manifest is the piece the BENCH_* rounds were missing: a single
+wall-clock number can't say *where* a regression lives, but a manifest
+carries the per-stage decomposition (from the span tracer), the six
+parity accumulators plus every registry counter, and histogram summaries
+(RPC latency, compile times) — enough to diff two runs stage by stage
+without log archaeology.
+
+Schema (``spark_examples_tpu.run_manifest/v1``), validated by
+``scripts/validate_trace.py``:
+
+- ``schema``/``created_unix``/``argv``/``command`` — provenance;
+- ``config`` — the resolved flag surface (JSON-serializable values only);
+- ``environment`` — python/platform, and when jax is already imported
+  (never imported from here) the jax version, backend, device kinds and
+  process topology;
+- ``stages`` — ``{name: {"seconds": s, "count": n}}`` from the tracer;
+- ``counters``/``gauges``/``histograms`` — the registry snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+__all__ = ["MANIFEST_SCHEMA", "build_manifest", "write_manifest"]
+
+MANIFEST_SCHEMA = "spark_examples_tpu.run_manifest/v1"
+
+
+def _jsonable(value: Any) -> bool:
+    try:
+        json.dumps(value)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def _environment() -> Dict[str, Any]:
+    import platform
+
+    env: Dict[str, Any] = {
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+        "hostname": platform.node(),
+        "pid": os.getpid(),
+    }
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        # Only DESCRIBE an already-initialized jax — a manifest dump must
+        # never be the thing that first initializes a backend.
+        try:
+            env["jax"] = {
+                "version": jax.__version__,
+                "backend": jax.default_backend(),
+                "process_index": jax.process_index(),
+                "process_count": jax.process_count(),
+                "device_count": jax.device_count(),
+                "local_device_count": jax.local_device_count(),
+                "device_kinds": sorted(
+                    {d.device_kind for d in jax.local_devices()}
+                ),
+            }
+        except Exception:  # pragma: no cover - backend init failure
+            env["jax"] = {"version": getattr(jax, "__version__", "?")}
+    return env
+
+
+def build_manifest(
+    config: Optional[Dict[str, Any]] = None,
+    tracer=None,
+    registry=None,
+    command: str = "",
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Assemble the manifest dict from the session's tracer + registry."""
+    if tracer is None:
+        from spark_examples_tpu.obs.tracer import get_tracer
+
+        tracer = get_tracer()
+    if registry is None:
+        from spark_examples_tpu.obs.metrics import get_registry
+
+        registry = get_registry()
+    seconds = tracer.stage_seconds()
+    counts = tracer.stage_counts()
+    snap = registry.snapshot()
+    manifest: Dict[str, Any] = {
+        "schema": MANIFEST_SCHEMA,
+        "created_unix": time.time(),
+        "command": command,
+        "argv": list(sys.argv),
+        "config": {
+            k: v for k, v in (config or {}).items() if _jsonable(v)
+        },
+        "environment": _environment(),
+        "stages": {
+            name: {"seconds": secs, "count": counts.get(name, 0)}
+            for name, secs in sorted(seconds.items())
+        },
+        "counters": snap["counters"],
+        "gauges": snap["gauges"],
+        "histograms": snap["histograms"],
+    }
+    if extra:
+        manifest.update(
+            {k: v for k, v in extra.items() if _jsonable(v)}
+        )
+    return manifest
+
+
+def write_manifest(path: str, manifest: Dict[str, Any]) -> None:
+    """Write atomically (tmp + rename) with stable indentation."""
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)

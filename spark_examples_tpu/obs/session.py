@@ -1,0 +1,143 @@
+"""Telemetry session: ties tracer + registry + manifest to one run.
+
+The CLI (``--trace-out/--metrics-out/--manifest-out``) and ``bench.py``
+open exactly one session per process run. Entering a session installs a
+fresh ambient tracer and registry and ACTIVATES collection (the
+module-level ``span``/``instant``/RPC helpers stop being no-ops);
+exiting writes whichever artifacts were requested — on the failure path
+too, so a crashed run still leaves its partial timeline behind (the
+whole point when diagnosing stalls).
+
+:func:`flush_telemetry` writes the artifacts of the currently-active
+session immediately. It exists for fail-stop paths — the collective
+watchdog calls it right before ``os._exit`` so the trace that explains
+the hang survives the kill.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+from spark_examples_tpu.obs import metrics as _metrics
+from spark_examples_tpu.obs import tracer as _tracer
+from spark_examples_tpu.obs.manifest import build_manifest, write_manifest
+
+__all__ = ["TelemetrySession", "telemetry_session", "flush_telemetry"]
+
+_current: Optional["TelemetrySession"] = None
+_current_lock = threading.Lock()
+
+
+class TelemetrySession:
+    """Context manager owning one run's telemetry surfaces."""
+
+    def __init__(
+        self,
+        trace_out: Optional[str] = None,
+        metrics_out: Optional[str] = None,
+        manifest_out: Optional[str] = None,
+        config: Optional[Dict[str, Any]] = None,
+        command: str = "",
+        annotate_jax: bool = True,
+        xla_cost: bool = True,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """``xla_cost=False`` skips the per-kernel AOT lower+compile
+        cost recording (obs/xla.py) — it is one EXTRA compilation per
+        kernel signature, an observer effect workloads that time their
+        own warm phase (bench) only accept when artifacts were
+        explicitly requested."""
+        self.trace_out = trace_out
+        self.metrics_out = metrics_out
+        self.manifest_out = manifest_out
+        self.config = dict(config or {})
+        self.command = command
+        self.extra: Dict[str, Any] = dict(extra or {})
+        self.tracer = _tracer.SpanTracer(annotate_jax=annotate_jax)
+        self.registry = _metrics.MetricsRegistry()
+        self.xla_cost = xla_cost
+        self._root = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def __enter__(self) -> "TelemetrySession":
+        global _current
+        from spark_examples_tpu.obs import xla as _xla
+
+        _xla.reset(enabled=self.xla_cost)
+        _tracer.set_tracer(self.tracer, active=True)
+        _metrics.set_registry(self.registry)
+        with _current_lock:
+            _current = self
+        self._root = self.tracer.span("run", command=self.command)
+        self._root.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        global _current
+        if self._root is not None:
+            self._root.__exit__(exc_type, exc, tb)
+            self._root = None
+        if exc_type is not None:
+            self.tracer.instant(
+                "run_failed", scope="g", error=repr(exc)
+            )
+            self.extra.setdefault("outcome", "error")
+            self.extra.setdefault("error", repr(exc))
+        else:
+            self.extra.setdefault("outcome", "ok")
+        try:
+            self.flush()
+        finally:
+            with _current_lock:
+                _current = None
+            _tracer.set_tracer(None)
+            _metrics.set_registry(None)
+        return False
+
+    # -- output -------------------------------------------------------------
+
+    def flush(self) -> None:
+        """Write every requested artifact now (idempotent)."""
+        if self.trace_out:
+            self.tracer.write(self.trace_out)
+        if self.metrics_out:
+            self.registry.write_prometheus(self.metrics_out)
+            # The JSONL sink rides next to the exposition: same name,
+            # .jsonl suffix, one snapshot line appended per flush.
+            self.registry.write_jsonl(self.metrics_out + ".jsonl")
+        if self.manifest_out:
+            write_manifest(self.manifest_out, self.manifest())
+
+    def manifest(self) -> Dict[str, Any]:
+        return build_manifest(
+            config=self.config,
+            tracer=self.tracer,
+            registry=self.registry,
+            command=self.command,
+            extra=self.extra,
+        )
+
+
+def telemetry_session(**kwargs: Any) -> TelemetrySession:
+    """Sugar: ``with telemetry_session(trace_out=...) as s:``."""
+    return TelemetrySession(**kwargs)
+
+
+def flush_telemetry(reason: str = "") -> None:
+    """Best-effort immediate flush of the active session (fail-stop
+    paths: called before ``os._exit`` so the timeline survives)."""
+    with _current_lock:
+        session = _current
+    if session is None:
+        return
+    try:
+        if reason:
+            session.tracer.instant("flush", scope="p", reason=reason)
+        session.flush()
+    except Exception:  # pragma: no cover - a dying process must not
+        print(  # fail for want of a trace file
+            "WARNING: telemetry flush failed", file=sys.stderr
+        )

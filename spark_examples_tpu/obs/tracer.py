@@ -1,0 +1,294 @@
+"""Thread-safe span tracer emitting Chrome trace-event JSON.
+
+The trace format is the Chrome/Catapult "trace event" JSON (the
+``{"traceEvents": [...]}`` object form), which Perfetto
+(ui.perfetto.dev), ``chrome://tracing``, and TensorBoard all load
+natively — the same container ``jax.profiler`` traces render in, so one
+viewer shows host-side pipeline spans next to device timelines.
+
+Event kinds used:
+
+- ``ph="X"`` complete events — one per finished span, with ``ts``/``dur``
+  in microseconds relative to the tracer epoch;
+- ``ph="i"`` instant events — point-in-time marks (watchdog stall
+  detections, elastic lane discards, HTTP retries) so anomalies are
+  visible ON the timeline, not only in stderr;
+- ``ph="M"`` metadata events — process/thread names so Perfetto's track
+  labels read as roles, not bare tids.
+
+Concurrency model: the *span stack* is thread-local (a span opened on a
+feeder thread can never corrupt another thread's nesting — the exact
+bug ``StageTimer`` had), while the finished-event list and the per-name
+second accumulators are guarded by one lock taken only at span *exit*
+(span enter is lock-free).
+
+``jax.profiler`` alignment: when ``annotate_jax=True`` (the telemetry
+session default) and jax is already imported, each span also enters a
+``jax.profiler.TraceAnnotation`` so device traces captured via
+``--trace-dir`` carry the same region names. Jax is never imported here
+— host-only commands stay jax-free.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+__all__ = [
+    "SpanTracer",
+    "collection_active",
+    "get_tracer",
+    "set_tracer",
+    "span",
+    "instant",
+]
+
+# Hard cap on buffered events: a runaway per-record span can otherwise
+# grow the trace without bound; past the cap events are counted, not
+# stored, and the drop count lands in the trace as a final instant.
+DEFAULT_MAX_EVENTS = 1_000_000
+
+
+def _jax_annotation(name: str):
+    """A jax.profiler.TraceAnnotation for ``name`` IF jax is already
+    imported, else None. Never imports jax itself."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - profiler API unavailable
+        return None
+
+
+class SpanTracer:
+    """Collects spans/instants; serializes to Chrome trace-event JSON."""
+
+    def __init__(
+        self,
+        process_name: str = "spark_examples_tpu",
+        annotate_jax: bool = False,
+        max_events: int = DEFAULT_MAX_EVENTS,
+    ) -> None:
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._local = threading.local()
+        self._pid = os.getpid()
+        self._t0 = time.perf_counter()
+        self._epoch_unix = time.time()
+        self._process_name = process_name
+        self._annotate_jax = annotate_jax
+        self._max_events = max_events
+        self._dropped = 0
+        # Aggregates survive even when raw events overflow the cap, so
+        # the manifest's stage table is exact for arbitrarily long runs.
+        self._seconds: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+
+    # -- time ---------------------------------------------------------------
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    # -- thread-local span stack -------------------------------------------
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def current_span(self) -> str:
+        """Name of the innermost open span on THIS thread ('' if none)."""
+        stack = self._stack()
+        return stack[-1][0] if stack else ""
+
+    # -- recording ----------------------------------------------------------
+
+    def _append(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._events) >= self._max_events:
+                self._dropped += 1
+                return
+            self._events.append(event)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args: Any) -> Iterator[None]:
+        """Record one complete ("X") event around the body.
+
+        Safe from any thread; nesting is tracked per thread. ``args``
+        must be JSON-serializable (they land in the event's ``args``).
+        """
+        tid = threading.get_ident()
+        t_start = self._now_us()
+        self._stack().append((name, t_start))
+        annotation = _jax_annotation(name) if self._annotate_jax else None
+        if annotation is not None:
+            annotation.__enter__()
+        try:
+            yield
+        finally:
+            if annotation is not None:
+                annotation.__exit__(None, None, None)
+            self._stack().pop()
+            dur = self._now_us() - t_start
+            event = {
+                "name": name,
+                "ph": "X",
+                "ts": t_start,
+                "dur": dur,
+                "pid": self._pid,
+                "tid": tid,
+            }
+            if args:
+                event["args"] = args
+            with self._lock:
+                self._seconds[name] = (
+                    self._seconds.get(name, 0.0) + dur / 1e6
+                )
+                self._counts[name] = self._counts.get(name, 0) + 1
+                if len(self._events) < self._max_events:
+                    self._events.append(event)
+                else:
+                    self._dropped += 1
+
+    def instant(self, name: str, scope: str = "t", **args: Any) -> None:
+        """Record a point-in-time ("i") event: stalls, retries, drops.
+
+        ``scope``: "t" thread, "p" process, "g" global — how tall the
+        mark renders in the viewer.
+        """
+        event = {
+            "name": name,
+            "ph": "i",
+            "ts": self._now_us(),
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+            "s": scope,
+        }
+        if args:
+            event["args"] = args
+        self._append(event)
+
+    def counter(self, name: str, **series: float) -> None:
+        """Record a counter ("C") sample — renders as a stacked area."""
+        self._append(
+            {
+                "name": name,
+                "ph": "C",
+                "ts": self._now_us(),
+                "pid": self._pid,
+                "tid": threading.get_ident(),
+                "args": dict(series),
+            }
+        )
+
+    # -- aggregates / output -------------------------------------------------
+
+    def stage_seconds(self) -> Dict[str, float]:
+        """Accumulated wall-clock per span name (manifest stage table)."""
+        with self._lock:
+            return dict(self._seconds)
+
+    def stage_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def to_chrome(self) -> Dict[str, Any]:
+        """The Chrome trace-event JSON object (Perfetto-loadable)."""
+        meta = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": self._pid,
+                "tid": 0,
+                "args": {"name": self._process_name},
+            }
+        ]
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+        if dropped:
+            events.append(
+                {
+                    "name": "tracer_events_dropped",
+                    "ph": "i",
+                    "ts": self._now_us(),
+                    "pid": self._pid,
+                    "tid": threading.get_ident(),
+                    "s": "p",
+                    "args": {"dropped": dropped},
+                }
+            )
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": self._process_name,
+                "trace_epoch_unix": self._epoch_unix,
+            },
+        }
+
+    def write(self, path: str) -> None:
+        """Write the trace JSON atomically (tmp + rename)."""
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_chrome(), f)
+        os.replace(tmp, path)
+
+
+# -- ambient tracer ----------------------------------------------------------
+#
+# Library code (ops, transports, watchdog, elastic) records through the
+# module-level helpers below, which no-op unless a telemetry session
+# activated collection — the data plane pays ~one attribute read per
+# call when telemetry is off.
+
+_ambient: Optional[SpanTracer] = None
+_active: bool = False
+
+
+def get_tracer() -> SpanTracer:
+    """The ambient tracer (created on first use)."""
+    global _ambient
+    if _ambient is None:
+        _ambient = SpanTracer()
+    return _ambient
+
+
+def set_tracer(tracer: Optional[SpanTracer], active: bool = True) -> None:
+    """Install (or clear, with ``None``) the ambient tracer.
+
+    ``active`` gates the module-level ``span``/``instant`` helpers; a
+    telemetry session sets it True on entry and False on exit.
+    """
+    global _ambient, _active
+    _ambient = tracer
+    _active = active and tracer is not None
+
+
+def collection_active() -> bool:
+    return _active
+
+
+@contextlib.contextmanager
+def span(name: str, **args: Any) -> Iterator[None]:
+    """Ambient span: records into the session tracer, no-op otherwise."""
+    if not _active:
+        yield
+        return
+    with get_tracer().span(name, **args):
+        yield
+
+
+def instant(name: str, scope: str = "t", **args: Any) -> None:
+    """Ambient instant event: no-op unless a session is active."""
+    if _active:
+        get_tracer().instant(name, scope=scope, **args)

@@ -126,6 +126,9 @@ def gramian(x, compute_dtype=None, accum_dtype=jnp.float32):
     compute_dtype = resolve_gramian_compute_dtype(
         x.dtype, accum_dtype, compute_dtype
     )
+    from spark_examples_tpu.obs.xla import record_compiled
+
+    record_compiled("gramian", _gramian_jit, x, compute_dtype, accum_dtype)
     return _gramian_jit(x, compute_dtype, accum_dtype)
 
 
@@ -250,7 +253,9 @@ def gramian_blockwise(
     Returns:
       ``(N, N)`` device Gramian.
     """
+    from spark_examples_tpu import obs
     from spark_examples_tpu.arrays.feed import device_prefetch
+    from spark_examples_tpu.obs.xla import record_compiled
 
     g = jnp.zeros((n_samples, n_samples), dtype=accum_dtype)
     if device is not None:
@@ -264,9 +269,36 @@ def gramian_blockwise(
             for xb in blocks:
                 yield xb if prepacked else pack_indicator_block(xb)
 
-        for xp in device_prefetch(packed_stream(), device=device):
-            g = gramian_accumulate_packed(g, xp, compute_dtype=compute_dtype)
+        with obs.span("gramian_blockwise", packed=True):
+            for i, xp in enumerate(
+                device_prefetch(packed_stream(), device=device)
+            ):
+                if i == 0:
+                    record_compiled(
+                        "gramian_accumulate_packed",
+                        _gramian_accumulate_packed_jit,
+                        g,
+                        xp,
+                        8 * xp.shape[1],
+                        resolve_gramian_compute_dtype(
+                            jnp.int8, g.dtype, compute_dtype
+                        ),
+                    )
+                g = gramian_accumulate_packed(
+                    g, xp, compute_dtype=compute_dtype
+                )
         return g
-    for xb in device_prefetch(blocks, device=device):
-        g = gramian_accumulate(g, xb, compute_dtype=compute_dtype)
+    with obs.span("gramian_blockwise", packed=False):
+        for i, xb in enumerate(device_prefetch(blocks, device=device)):
+            if i == 0:
+                record_compiled(
+                    "gramian_accumulate",
+                    _gramian_accumulate_jit,
+                    g,
+                    xb,
+                    resolve_gramian_compute_dtype(
+                        xb.dtype, g.dtype, compute_dtype
+                    ),
+                )
+            g = gramian_accumulate(g, xb, compute_dtype=compute_dtype)
     return g

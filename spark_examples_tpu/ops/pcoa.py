@@ -132,6 +132,14 @@ def principal_components(c, k):
 
 
 @partial(jax.jit, static_argnames=("k", "scale"))
+def _pcoa_jit(g, k, scale):
+    c = double_center(g)
+    coords, w = principal_components(c, k)
+    if scale:
+        coords = coords * jnp.sqrt(jnp.maximum(w, 0.0))
+    return coords, w
+
+
 def pcoa(g, k, scale=False):
     """Full PCoA of a raw similarity Gramian: center → eigendecompose.
 
@@ -144,12 +152,17 @@ def pcoa(g, k, scale=False):
 
     Returns:
       ``(coords, eigvals)`` as in :func:`principal_components`.
+
+    The jitted body lives in ``_pcoa_jit``; this wrapper exists so the
+    telemetry session (when active) can record the kernel's compile time
+    and XLA cost analysis per call signature.
     """
-    c = double_center(g)
-    coords, w = principal_components(c, k)
-    if scale:
-        coords = coords * jnp.sqrt(jnp.maximum(w, 0.0))
-    return coords, w
+    from spark_examples_tpu import obs
+    from spark_examples_tpu.obs.xla import record_compiled
+
+    record_compiled("pcoa", _pcoa_jit, g, k, scale)
+    with obs.span("pcoa", n=int(g.shape[0]), k=int(k)):
+        return _pcoa_jit(g, k, scale)
 
 
 def mllib_principal_components_reference(g, k):

@@ -76,6 +76,8 @@ def allreduce_gramian(g_local, chunk_bytes: int = 64 << 20):
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
+    from spark_examples_tpu import obs
+
     if not getattr(g_local, "is_fully_addressable", True):
         # In this framework a process-spanning array can only come from the
         # global-mesh accumulators (gramian_blockwise_global / the
@@ -94,9 +96,12 @@ def allreduce_gramian(g_local, chunk_bytes: int = 64 << 20):
     itemsize = np.dtype(arr.dtype).itemsize
     rows = max(1, chunk_bytes // max(1, n * itemsize))
     out = np.empty(arr.shape, dtype=arr.dtype)
-    for r0 in range(0, n, rows):
-        part = multihost_utils.process_allgather(arr[r0 : r0 + rows])
-        out[r0 : r0 + rows] = np.asarray(jnp.sum(jnp.asarray(part), axis=0))
+    with obs.span("allreduce_gramian", n=int(n), row_chunk=int(rows)):
+        for r0 in range(0, n, rows):
+            part = multihost_utils.process_allgather(arr[r0 : r0 + rows])
+            out[r0 : r0 + rows] = np.asarray(
+                jnp.sum(jnp.asarray(part), axis=0)
+            )
     return jnp.asarray(out)
 
 
@@ -110,11 +115,16 @@ def allreduce_host_stats(stats: IoStats) -> IoStats:
         return stats
     from jax.experimental import multihost_utils
 
+    from spark_examples_tpu import obs
+
     vec = np.asarray(stats.as_vector(), dtype=np.int64)
-    total = np.asarray(
-        multihost_utils.process_allgather(vec)
-    ).sum(axis=0)
-    merged = IoStats()
+    with obs.span("allreduce_host_stats"):
+        total = np.asarray(
+            multihost_utils.process_allgather(vec)
+        ).sum(axis=0)
+    # untracked: this is a merged VIEW of counters the registry
+    # collector already sums from the per-source instances.
+    merged = IoStats.untracked()
     merged.add(
         partitions=int(total[0]),
         reference_bases=int(total[1]),

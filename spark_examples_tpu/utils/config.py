@@ -115,6 +115,13 @@ class PcaConfig(GenomicsConfig):
     # turns that into a loud exit-77 + snapshot resume (utils/watchdog.py).
     # None = disabled.
     collective_timeout: Optional[float] = None
+    # Unified telemetry artifacts (spark_examples_tpu.obs): Chrome-trace
+    # span timeline, Prometheus metrics dump (+ .jsonl snapshot), and the
+    # machine-readable run manifest. None = telemetry off (zero hot-path
+    # cost).
+    trace_out: Optional[str] = None
+    metrics_out: Optional[str] = None
+    manifest_out: Optional[str] = None
 
 
 def add_genomics_flags(p: argparse.ArgumentParser) -> None:
@@ -258,6 +265,27 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         "--trace-dir",
         default=None,
         help="Write a jax.profiler trace of the run here",
+    )
+    p.add_argument(
+        "--trace-out",
+        default=None,
+        help="Write a Chrome-trace-event JSON span timeline here "
+        "(open in Perfetto: ui.perfetto.dev; host-side stages, RPC "
+        "spans, watchdog/retry instant events)",
+    )
+    p.add_argument(
+        "--metrics-out",
+        default=None,
+        help="Write a Prometheus text-format metrics dump here "
+        "(counters/gauges/latency histograms; a .jsonl machine-readable "
+        "snapshot is written alongside)",
+    )
+    p.add_argument(
+        "--manifest-out",
+        default=None,
+        help="Write the machine-readable run manifest JSON here "
+        "(config, device topology, stage timings, counters, histogram "
+        "summaries — the per-run artifact BENCH rounds diff)",
     )
     p.add_argument(
         "--sample-sharded",

@@ -178,6 +178,9 @@ def _read_lane(path: str, run_digest: str, n: int) -> Optional[Lane]:
             f"WARNING: unreadable elastic lane {path}; ignoring.",
             file=sys.stderr,
         )
+        from spark_examples_tpu import obs
+
+        obs.instant("elastic_unreadable_lane", scope="p", path=path)
         return None
 
 
@@ -215,6 +218,15 @@ def load_lanes(directory: str, run_digest: str, n: int) -> List[Lane]:
                 "other lanes (corruption?); discarding it — its units "
                 "will be re-executed.",
                 file=sys.stderr,
+            )
+            from spark_examples_tpu import obs
+
+            obs.instant(
+                "elastic_lane_discarded",
+                scope="p",
+                path=lane.path,
+                reason="partial_overlap",
+                units_reexecuted=len(lane.units),
             )
     return kept
 

@@ -7,17 +7,84 @@ the driver; here counters are per-process (threads share them via atomic
 increments under the GIL) and multi-host totals are merged with an explicit
 all-reduce of the counter vector — see
 :func:`spark_examples_tpu.parallel.distributed.allreduce_host_stats`.
+
+Registry backing: every live ``IoStats`` instance is also visible to the
+telemetry metrics registry (:mod:`spark_examples_tpu.obs.metrics`) as
+``genomics_io_<counter>_total`` — summed over instances by a collector
+evaluated at *scrape/manifest* time, not on the hot path. ``add`` runs
+once per ingested record (millions per run), so the counters stay plain
+per-instance ints here and the registry reads them when someone actually
+asks; the ``report()`` block the parity tests pin is byte-identical to
+the reference's.
 """
 
 from __future__ import annotations
 
 import threading
+import weakref
 from dataclasses import dataclass, field
 
-__all__ = ["IoStats"]
+__all__ = ["IoStats", "COUNTER_FIELDS"]
+
+# The accumulator fields, in as_vector()/report() order.
+COUNTER_FIELDS = (
+    "partitions",
+    "reference_bases",
+    "requests",
+    "unsuccessful_responses",
+    "io_exceptions",
+    "variants_read",
+    "reads_read",
+)
+
+# Live instances for the registry collector (weak: a dropped source's
+# stats must not leak). A dying instance retires its final counts into
+# ``_retired`` from ``__del__`` — a source GC'd before the end-of-run
+# manifest flush (the common CLI shape: the driver drops its source
+# before the telemetry session exits) still contributes its records.
+_instances: "weakref.WeakSet[IoStats]" = weakref.WeakSet()
+_retired = dict.fromkeys(COUNTER_FIELDS, 0)
+_retired_lock = threading.Lock()
 
 
-@dataclass
+def _collect_io_stats():
+    """Registry collector: counters summed over live + retired IoStats.
+
+    NOTE: the sum is a *process-wide cumulative diagnostic view* — a
+    merged copy (``allreduce_host_stats`` on a multi-host run, or an
+    explicit ``merge``) is itself an instance, so merged totals can
+    double-count here; per-instance accounting (the ``report()`` block)
+    remains the parity-exact surface.
+    """
+    with _retired_lock:
+        totals = dict(_retired)
+    for inst in list(_instances):
+        for name in COUNTER_FIELDS:
+            totals[name] += getattr(inst, name)
+    for name in COUNTER_FIELDS:
+        yield (
+            f"genomics_io_{name}_total",
+            "counter",
+            f"IoStats accumulator '{name}' summed over sources "
+            "(VariantsRDD.scala:160-180 parity counters)",
+            {},
+            float(totals[name]),
+        )
+
+
+def _register_collector() -> None:
+    from spark_examples_tpu.obs.metrics import register_collector
+
+    register_collector(_collect_io_stats)
+
+
+_register_collector()
+
+
+# eq=False keeps the default identity hash: instances live in the
+# collector's WeakSet (a generated __eq__ would set __hash__ = None).
+# Nothing compared IoStats by value — counts are read field-wise.
+@dataclass(eq=False)
 class IoStats:
     partitions: int = 0
     reference_bases: int = 0
@@ -30,33 +97,42 @@ class IoStats:
         default_factory=threading.Lock, repr=False, compare=False
     )
 
+    def __post_init__(self) -> None:
+        _instances.add(self)
+
+    @classmethod
+    def untracked(cls) -> "IoStats":
+        """An instance INVISIBLE to the registry collector — for merged
+        views (``allreduce_host_stats``, explicit ``merge`` targets)
+        whose counts are copies of already-tracked instances; tracking
+        them would double-count the manifest's ``genomics_io_*_total``
+        on exactly the multi-host runs telemetry targets."""
+        inst = cls()
+        _instances.discard(inst)
+        inst._untracked = True
+        return inst
+
+    def __del__(self) -> None:
+        try:
+            if getattr(self, "_untracked", False):
+                return
+            with _retired_lock:
+                for name in COUNTER_FIELDS:
+                    _retired[name] += getattr(self, name)
+        except Exception:  # pragma: no cover - interpreter shutdown
+            pass
+
     def add(self, **deltas: int) -> None:
         with self._lock:
             for name, d in deltas.items():
                 setattr(self, name, getattr(self, name) + d)
 
     def merge(self, other: "IoStats") -> None:
-        self.add(
-            partitions=other.partitions,
-            reference_bases=other.reference_bases,
-            requests=other.requests,
-            unsuccessful_responses=other.unsuccessful_responses,
-            io_exceptions=other.io_exceptions,
-            variants_read=other.variants_read,
-            reads_read=other.reads_read,
-        )
+        self.add(**{f: getattr(other, f) for f in COUNTER_FIELDS})
 
     def as_vector(self):
         """Counter vector for device-side psum merging across hosts."""
-        return [
-            self.partitions,
-            self.reference_bases,
-            self.requests,
-            self.unsuccessful_responses,
-            self.io_exceptions,
-            self.variants_read,
-            self.reads_read,
-        ]
+        return [getattr(self, f) for f in COUNTER_FIELDS]
 
     def report(self) -> str:
         """The formatted block of VariantsRDD.scala:168-180."""

@@ -5,13 +5,23 @@ lean on the Spark UI — SURVEY.md §5); the TPU framework does better: an
 optional ``jax.profiler`` trace (viewable in TensorBoard/Perfetto) around
 any region, plus a lightweight stage timer whose report is the wall-clock
 decomposition of a pipeline run.
+
+Since the unified telemetry layer landed, :class:`StageTimer` is a thin
+shim over :mod:`spark_examples_tpu.obs`: every stage also records an
+ambient tracer span (so driver stages land on the Chrome-trace timeline
+and in the run manifest when ``--trace-out``/``--manifest-out`` are
+given) and every note an instant event. The report block — the one
+artifact every run prints — is unchanged.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional
+
+from spark_examples_tpu import obs
 
 __all__ = ["StageTimer", "profiler_trace"]
 
@@ -22,12 +32,27 @@ class StageTimer:
     Stages may also attach short diagnostic notes (e.g. the spectral gap
     ratio from the randomized eig) which print alongside the timings —
     the report is the one artifact every run shows the user.
+
+    Thread-safe: the active-stage stack is **thread-local** (concurrent
+    feeder threads each nest their own stages; one thread closing a
+    stage can never pop another thread's), and the ``seconds``/``notes``
+    accumulation is lock-guarded — the same stage name timed on several
+    threads sums correctly.
     """
 
     def __init__(self) -> None:
         self.seconds: Dict[str, float] = {}
-        self.notes: Dict[str, list] = {}
-        self._active: list = []
+        self.notes: Dict[str, List[str]] = {}
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        # Insertion order of first-finish per stage, for a stable report.
+        self._order: List[str] = []
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
 
     def note(self, text: str) -> None:
         """Attach a note to the currently-running stage.
@@ -35,31 +60,41 @@ class StageTimer:
         Library code deep under a stage (e.g. the eig kernels) need not
         know what the driver named its stages; a note issued outside any
         stage files under "" and still prints, so diagnostics can never
-        vanish by landing on an unknown key.
+        vanish by landing on an unknown key. The note is also mirrored
+        onto the trace timeline as an instant event.
         """
-        key = self._active[-1] if self._active else ""
-        self.notes.setdefault(key, []).append(text)
+        stack = self._stack()
+        key = stack[-1] if stack else ""
+        with self._lock:
+            self.notes.setdefault(key, []).append(text)
+        obs.instant("note", stage=key, text=text)
 
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
         t0 = time.perf_counter()
-        self._active.append(name)
+        self._stack().append(name)
         try:
-            yield
+            with obs.span(name):
+                yield
         finally:
-            self._active.pop()
-            self.seconds[name] = (
-                self.seconds.get(name, 0.0) + time.perf_counter() - t0
-            )
+            self._stack().pop()
+            dt = time.perf_counter() - t0
+            with self._lock:
+                if name not in self.seconds:
+                    self._order.append(name)
+                self.seconds[name] = self.seconds.get(name, 0.0) + dt
 
     def report(self) -> str:
-        total = sum(self.seconds.values())
+        with self._lock:
+            seconds = {k: self.seconds[k] for k in self._order}
+            notes = {k: list(v) for k, v in self.notes.items()}
+        total = sum(seconds.values())
         lines = ["Stage wall-clock", "----------------"]
-        for name, secs in self.seconds.items():
+        for name, secs in seconds.items():
             pct = 100.0 * secs / total if total else 0.0
             lines.append(f"{name}: {secs:.3f}s ({pct:.1f}%)")
-            lines.extend(f"  {n}" for n in self.notes.get(name, ()))
-        lines.extend(f"{n}" for n in self.notes.get("", ()))
+            lines.extend(f"  {n}" for n in notes.get(name, ()))
+        lines.extend(f"{n}" for n in notes.get("", ()))
         lines.append(f"total: {total:.3f}s")
         return "\n".join(lines)
 

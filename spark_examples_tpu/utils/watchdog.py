@@ -70,4 +70,18 @@ class CollectiveWatchdog:
             file=sys.stderr,
             flush=True,
         )
+        # The stall must be ON the trace timeline, not only in stderr —
+        # and the trace file must exist after os._exit, so flush now.
+        try:
+            from spark_examples_tpu import obs
+
+            obs.instant(
+                "collective_watchdog_fired",
+                scope="g",
+                phase=what,
+                timeout_s=self.timeout_s,
+            )
+            obs.flush_telemetry(reason=f"watchdog fired in '{what}'")
+        except Exception:  # pragma: no cover - dying anyway
+            pass
         os._exit(EXIT_COLLECTIVE_TIMEOUT)

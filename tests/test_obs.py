@@ -1,0 +1,497 @@
+"""Unified telemetry layer: tracer, registry, manifest, validation.
+
+Pins the contracts the observability subsystem ships on:
+
+- registry counter/histogram semantics under concurrent writers (the
+  transports feed them from shard-parallel ingest threads);
+- Chrome-trace JSON schema round-trip — every emitted trace must pass
+  ``scripts/validate_trace.py`` (the same check CI applies), i.e. load
+  in Perfetto;
+- ``StageTimer`` thread-safety (thread-local span stacks, locked
+  accumulation) and its unchanged report block;
+- ``IoStats`` parity: the exact ``report()`` format the reference pins
+  (VariantsRDD.scala:168-180) AND the counters' visibility through the
+  registry collector, including after the owning source is dropped;
+- manifest emission from a real (tiny, CPU) CLI pipeline run with
+  ``--trace-out/--metrics-out/--manifest-out`` — the acceptance shape:
+  stage timings, the parity counters, and an RPC latency histogram, all
+  schema-valid.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import threading
+
+import pytest
+
+from spark_examples_tpu import obs
+from spark_examples_tpu.obs.metrics import MetricsRegistry
+from spark_examples_tpu.obs.session import TelemetrySession
+from spark_examples_tpu.obs.tracer import SpanTracer
+from spark_examples_tpu.utils.stats import IoStats
+from spark_examples_tpu.utils.tracing import StageTimer
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_trace",
+        os.path.join(_REPO_ROOT, "scripts", "validate_trace.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+validate = _load_validator()
+
+
+class TestMetricsRegistry:
+    def test_counter_semantics_under_threads(self):
+        reg = MetricsRegistry()
+        c = reg.counter("req_total", "requests")
+        n_threads, per_thread = 8, 2000
+
+        def work():
+            for _ in range(per_thread):
+                c.inc()
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == n_threads * per_thread
+
+    def test_histogram_semantics_under_threads(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+        values = [0.05, 0.5, 5.0, 50.0]
+
+        def work():
+            for v in values:
+                h.observe(v)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert h.count == 8 * len(values)
+        assert h.sum == pytest.approx(8 * sum(values))
+        s = h.summary()
+        assert s["count"] == 32
+        assert s["min"] == pytest.approx(0.05)
+        assert s["max"] == pytest.approx(50.0)
+        assert 0.0 < s["p50"] <= 10.0
+
+    def test_counters_only_go_up(self):
+        c = MetricsRegistry().counter("c_total")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_kind_conflict_is_loud(self):
+        reg = MetricsRegistry()
+        reg.counter("x_total")
+        with pytest.raises(ValueError):
+            reg.gauge("x_total")
+
+    def test_labels_partition_series(self):
+        reg = MetricsRegistry()
+        c = reg.counter("rpc_total")
+        c.labels(transport="http").inc(2)
+        c.labels(transport="grpc").inc(3)
+        snap = reg.snapshot()
+        assert snap["counters"]['rpc_total{transport="http"}'] == 2
+        assert snap["counters"]['rpc_total{transport="grpc"}'] == 3
+
+    def test_prometheus_exposition_is_schema_valid(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("a_total", "help text").inc(5)
+        reg.gauge("g_now").set(-2.5)
+        h = reg.histogram("h_seconds", "latency")
+        h.labels(method="x").observe(0.3)
+        path = str(tmp_path / "m.prom")
+        reg.write_prometheus(path)
+        assert validate.validate_metrics(path) == []
+        text = open(path).read()
+        assert "# TYPE a_total counter" in text
+        assert 'h_seconds_bucket{method="x",le="+Inf"} 1' in text
+
+    def test_jsonl_sink_appends_snapshots(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("a_total").inc()
+        path = str(tmp_path / "m.jsonl")
+        reg.write_jsonl(path)
+        reg.counter("a_total").inc()
+        reg.write_jsonl(path)
+        lines = [json.loads(l) for l in open(path)]
+        assert len(lines) == 2
+        assert lines[0]["counters"]["a_total"] == 1
+        assert lines[1]["counters"]["a_total"] == 2
+
+
+class TestSpanTracer:
+    def test_trace_schema_roundtrip_under_threads(self, tmp_path):
+        tracer = SpanTracer()
+
+        def work(i):
+            with tracer.span("outer", worker=i):
+                with tracer.span("inner"):
+                    tracer.instant("mark", i=i)
+
+        threads = [
+            threading.Thread(target=work, args=(i,)) for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        path = str(tmp_path / "t.trace.json")
+        tracer.write(path)
+        assert validate.validate_trace(path) == []
+        doc = json.load(open(path))
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert names.count("outer") == 6
+        assert names.count("inner") == 6
+        assert names.count("mark") == 6
+        # Aggregates: each name accumulated once per span.
+        assert tracer.stage_counts()["outer"] == 6
+        assert tracer.stage_seconds()["inner"] >= 0.0
+
+    def test_span_stack_is_thread_local(self):
+        tracer = SpanTracer()
+        seen = {}
+        gate = threading.Barrier(2)
+
+        def a():
+            with tracer.span("a"):
+                gate.wait()
+                seen["a"] = tracer.current_span()
+                gate.wait()
+
+        def b():
+            with tracer.span("b"):
+                gate.wait()
+                seen["b"] = tracer.current_span()
+                gate.wait()
+
+        ta, tb = threading.Thread(target=a), threading.Thread(target=b)
+        ta.start(), tb.start()
+        ta.join(), tb.join()
+        assert seen == {"a": "a", "b": "b"}
+
+    def test_event_cap_counts_drops(self, tmp_path):
+        tracer = SpanTracer(max_events=3)
+        for i in range(10):
+            tracer.instant(f"e{i}")
+        doc = tracer.to_chrome()
+        dropped = [
+            e
+            for e in doc["traceEvents"]
+            if e["name"] == "tracer_events_dropped"
+        ]
+        assert dropped and dropped[0]["args"]["dropped"] == 7
+
+    def test_ambient_helpers_noop_without_session(self):
+        # No session active: module helpers must not record anywhere.
+        assert not obs.collection_active()
+        with obs.span("ghost"):
+            obs.instant("ghost_mark")
+        # A fresh session must not see pre-session ghosts.
+        with TelemetrySession() as s:
+            assert obs.collection_active()
+        assert "ghost" not in s.tracer.stage_seconds()
+
+
+class TestStageTimer:
+    def test_report_format_unchanged(self):
+        timer = StageTimer()
+        with timer.stage("ingest"):
+            timer.note("a note")
+        with timer.stage("pca"):
+            pass
+        timer.note("orphan note")
+        report = timer.report()
+        lines = report.splitlines()
+        assert lines[0] == "Stage wall-clock"
+        assert lines[1] == "----------------"
+        assert lines[2].startswith("ingest: ") and "%" in lines[2]
+        assert lines[3] == "  a note"
+        assert lines[4].startswith("pca: ")
+        assert lines[5] == "orphan note"
+        assert lines[6].startswith("total: ")
+
+    def test_concurrent_stages_accumulate_safely(self):
+        timer = StageTimer()
+        n_threads, per_thread = 8, 50
+
+        def work(i):
+            for _ in range(per_thread):
+                with timer.stage("shared"):
+                    pass
+                with timer.stage(f"own-{i}"):
+                    timer.note(f"note-{i}")
+
+        threads = [
+            threading.Thread(target=work, args=(i,)) for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert timer.seconds["shared"] >= 0.0
+        assert len(timer.seconds) == 1 + n_threads
+        # Notes filed under the stage open on THEIR thread, never a
+        # sibling's (the thread-local stack contract).
+        for i in range(n_threads):
+            assert timer.notes[f"own-{i}"] == [f"note-{i}"] * per_thread
+
+    def test_stages_mirror_into_active_session(self):
+        with TelemetrySession() as s:
+            timer = StageTimer()
+            with timer.stage("mirrored"):
+                pass
+        assert "mirrored" in s.tracer.stage_seconds()
+
+
+class TestIoStatsRegistryBacking:
+    def test_report_block_format_exact(self):
+        stats = IoStats()
+        stats.add(partitions=2, variants_read=7, reference_bases=100)
+        assert stats.report() == (
+            "Variants API stats\n"
+            "------------------\n"
+            "# of partitions: 2\n"
+            "# of reference bases requested: 100\n"
+            "# of API requests: 0\n"
+            "# of unsuccessful responses: 0\n"
+            "# of IO exceptions: 0\n"
+            "# of variants read: 7\n"
+            "# of reads read: 0\n"
+        )
+
+    def test_live_instance_visible_through_collector(self):
+        reg = MetricsRegistry()
+        before = reg.snapshot()["counters"][
+            "genomics_io_variants_read_total"
+        ]
+        stats = IoStats()
+        stats.add(variants_read=11)
+        after = reg.snapshot()["counters"][
+            "genomics_io_variants_read_total"
+        ]
+        assert after - before == 11
+        del stats  # keep referenced until the second snapshot
+
+    def test_untracked_merge_view_is_invisible_to_collector(self):
+        # allreduce_host_stats builds a merged VIEW of per-source
+        # counters; tracking it would double-count multi-host manifests.
+        reg = MetricsRegistry()
+        before = reg.snapshot()["counters"][
+            "genomics_io_variants_read_total"
+        ]
+        src = IoStats()
+        src.add(variants_read=9)
+        merged = IoStats.untracked()
+        merged.merge(src)
+        after = reg.snapshot()["counters"][
+            "genomics_io_variants_read_total"
+        ]
+        assert after - before == 9  # src only, never the merged copy
+        del merged
+        import gc
+
+        gc.collect()
+        final = reg.snapshot()["counters"][
+            "genomics_io_variants_read_total"
+        ]
+        assert final - before == 9  # untracked never retires either
+        del src
+
+    def test_dropped_instance_counts_are_retired_not_lost(self):
+        reg = MetricsRegistry()
+        stats = IoStats()
+        stats.add(requests=5)
+        del stats
+        import gc
+
+        gc.collect()
+        # The retired totals keep contributing after GC — the end-of-run
+        # manifest flush happens after the driver drops its source.
+        assert (
+            reg.snapshot()["counters"]["genomics_io_requests_total"] >= 5
+        )
+
+
+class TestValidator:
+    def test_malformed_trace_fails(self, tmp_path):
+        path = tmp_path / "bad.trace.json"
+        path.write_text(
+            json.dumps(
+                {"traceEvents": [{"name": "x", "ph": "X", "pid": 1}]}
+            )
+        )
+        errs = validate.validate_trace(str(path))
+        assert any("ts" in e for e in errs)
+        assert any("dur" in e for e in errs)
+
+    def test_malformed_metrics_fails(self, tmp_path):
+        path = tmp_path / "bad.prom"
+        path.write_text("this is { not prometheus\n")
+        assert validate.validate_metrics(str(path)) != []
+
+    def test_manifest_missing_keys_fails(self, tmp_path):
+        path = tmp_path / "bad.manifest.json"
+        path.write_text(json.dumps({"schema": "nope"}))
+        errs = validate.validate_manifest(str(path))
+        assert any("stages" in e for e in errs)
+
+    def test_cli_entry_point_exit_codes(self, tmp_path, capsys):
+        good = tmp_path / "ok.trace.json"
+        t = SpanTracer()
+        t.instant("x")
+        t.write(str(good))
+        assert validate.main(["--trace", str(good)]) == 0
+        bad = tmp_path / "bad.trace.json"
+        bad.write_text("{}")
+        assert validate.main(["--trace", str(bad)]) == 1
+
+
+class TestTelemetrySession:
+    def test_artifacts_written_on_failure_path(self, tmp_path):
+        trace = str(tmp_path / "f.trace.json")
+        manifest = str(tmp_path / "f.manifest.json")
+        with pytest.raises(RuntimeError):
+            with TelemetrySession(
+                trace_out=trace, manifest_out=manifest, command="boom"
+            ):
+                with obs.span("doomed"):
+                    raise RuntimeError("simulated crash")
+        assert validate.validate_trace(trace) == []
+        mf = json.load(open(manifest))
+        assert mf["outcome"] == "error"
+        assert "doomed" in mf["stages"]
+
+    def test_flush_telemetry_midrun(self, tmp_path):
+        # The watchdog's fail-stop path: flush BEFORE os._exit.
+        trace = str(tmp_path / "w.trace.json")
+        with TelemetrySession(trace_out=trace):
+            obs.instant("collective_watchdog_fired", phase="merge")
+            obs.flush_telemetry(reason="test")
+            assert validate.validate_trace(trace) == []
+            doc = json.load(open(trace))
+            names = [e["name"] for e in doc["traceEvents"]]
+            assert "collective_watchdog_fired" in names
+
+    def test_rpc_timer_feeds_session_registry(self):
+        with TelemetrySession() as s:
+            with obs.rpc_timer("test", "Op"):
+                pass
+            with pytest.raises(IOError):
+                with obs.rpc_timer("test", "Op"):
+                    raise IOError("boom")
+        snap = s.registry.snapshot()
+        key = 'genomics_rpc_latency_seconds{method="Op",transport="test"}'
+        assert snap["histograms"][key]["count"] == 2
+        err_key = 'genomics_rpc_errors_total{method="Op",transport="test"}'
+        assert snap["counters"][err_key] == 1
+
+
+class TestPipelineManifestEmission:
+    """The acceptance shape: a CPU-only CLI pca run with all three
+    outputs produces Perfetto-loadable trace JSON, a valid Prometheus
+    dump, and a manifest with stage timings + parity counters + an RPC
+    latency histogram."""
+
+    @pytest.fixture(scope="class")
+    def run_artifacts(self, tmp_path_factory):
+        from spark_examples_tpu.cli.main import main
+
+        tmp_path = tmp_path_factory.mktemp("obs_cli")
+        paths = {
+            "trace": str(tmp_path / "run.trace.json"),
+            "metrics": str(tmp_path / "run.metrics.prom"),
+            "manifest": str(tmp_path / "run.manifest.json"),
+        }
+        old = os.environ.get("SPARK_EXAMPLES_TPU_COMPILE_CACHE")
+        os.environ["SPARK_EXAMPLES_TPU_COMPILE_CACHE"] = "0"
+        try:
+            rc = main(
+                [
+                    "pca",
+                    "--fixture-samples",
+                    "8",
+                    "--fixture-variants",
+                    "64",
+                    "--output-path",
+                    str(tmp_path / "out"),
+                    "--trace-out",
+                    paths["trace"],
+                    "--metrics-out",
+                    paths["metrics"],
+                    "--manifest-out",
+                    paths["manifest"],
+                ]
+            )
+        finally:
+            if old is None:
+                os.environ.pop("SPARK_EXAMPLES_TPU_COMPILE_CACHE", None)
+            else:
+                os.environ["SPARK_EXAMPLES_TPU_COMPILE_CACHE"] = old
+        assert rc == 0
+        return paths
+
+    def test_all_artifacts_schema_valid(self, run_artifacts):
+        assert validate.validate_trace(run_artifacts["trace"]) == []
+        assert validate.validate_metrics(run_artifacts["metrics"]) == []
+        assert (
+            validate.validate_manifest(run_artifacts["manifest"]) == []
+        )
+
+    def test_manifest_has_stage_timings(self, run_artifacts):
+        mf = json.load(open(run_artifacts["manifest"]))
+        for stage in ("run", "ingest+gramian", "pca", "emit"):
+            assert stage in mf["stages"], mf["stages"].keys()
+            assert mf["stages"][stage]["seconds"] >= 0.0
+        assert mf["command"] == "pca"
+        assert mf["config"]["fixture_samples"] == 8
+        assert mf["environment"]["jax"]["backend"] == "cpu"
+
+    def test_manifest_has_parity_counters(self, run_artifacts):
+        mf = json.load(open(run_artifacts["manifest"]))
+        for field in (
+            "partitions",
+            "reference_bases",
+            "requests",
+            "unsuccessful_responses",
+            "io_exceptions",
+            "variants_read",
+            "reads_read",
+        ):
+            assert f"genomics_io_{field}_total" in mf["counters"]
+        # The run read its 64 fixture variants (process-cumulative
+        # counter: other tests may have contributed more).
+        assert mf["counters"]["genomics_io_variants_read_total"] >= 64
+        # The driver-merged job-end totals are exact per run (gauges in
+        # the session-fresh registry, set at report_io_stats time).
+        assert mf["gauges"]["genomics_io_merged_variants_read"] == 64
+
+    def test_manifest_has_rpc_latency_histogram(self, run_artifacts):
+        mf = json.load(open(run_artifacts["manifest"]))
+        rpc = {
+            k: v
+            for k, v in mf["histograms"].items()
+            if k.startswith("genomics_rpc_latency_seconds")
+        }
+        assert rpc, list(mf["histograms"])
+        assert any(v["count"] >= 1 for v in rpc.values())
+
+    def test_trace_has_driver_stages(self, run_artifacts):
+        doc = json.load(open(run_artifacts["trace"]))
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert {"run", "ingest+gramian", "pca", "emit"} <= names
