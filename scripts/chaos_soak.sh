@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Randomized chaos soak: run the full CPU pipeline under randomized
+# seeded fault plans (transport errors/truncation/corruption, shard
+# worker death, slow lanes, torn checkpoint writes) and require results
+# numerically identical to the fault-free run — plus a clean resume
+# over whatever checkpoint residue each plan left behind.
+#
+# Usage:
+#   scripts/chaos_soak.sh                 # default CHAOS_SOAK_ITERS=5
+#   CHAOS_SOAK_ITERS=25 scripts/chaos_soak.sh
+#   scripts/chaos_soak.sh -k randomized   # extra pytest args pass through
+#
+# The deterministic resilience suite (tier-1) lives in the same file and
+# runs on every CI pass; this entry point is the long-running fuzz loop
+# (marked `slow`, excluded from tier-1). See docs/RESILIENCE.md.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+: "${CHAOS_SOAK_ITERS:=5}"
+
+exec env JAX_PLATFORMS=cpu CHAOS_SOAK_ITERS="$CHAOS_SOAK_ITERS" \
+    python -m pytest tests/test_resilience.py -q -m slow \
+    -p no:cacheprovider "$@"

@@ -51,6 +51,31 @@ def _network_source(args):
     """
     from spark_examples_tpu.genomics.auth import get_access_token
     from spark_examples_tpu.genomics.service import HttpVariantSource
+    from spark_examples_tpu.resilience import BreakerSet, RetryPolicy
+    from spark_examples_tpu.utils.config import GenomicsConfig
+
+    # The declarative resilience surface (docs/RESILIENCE.md): one
+    # policy + breaker config for whichever transport serves the run.
+    # Fallback defaults come from the config dataclass (itself derived
+    # from the resilience layer) — one source of truth.
+    retry_policy = RetryPolicy(
+        max_attempts=max(
+            1,
+            getattr(args, "rpc_retries", GenomicsConfig.rpc_retries),
+        ),
+        deadline=getattr(args, "rpc_retry_deadline", None),
+    )
+
+    def breakers(prefix: str) -> BreakerSet:
+        return BreakerSet(
+            prefix,
+            failure_threshold=getattr(
+                args, "breaker_threshold", GenomicsConfig.breaker_threshold
+            ),
+            cooldown_s=getattr(
+                args, "breaker_cooldown", GenomicsConfig.breaker_cooldown
+            ),
+        )
 
     if args.api_url.startswith("grpc://"):
         # The HTTP/2 server-streaming transport (the reference's bulk
@@ -75,15 +100,23 @@ def _network_source(args):
                 "'spark_examples_tpu[grpc]'); the http:// transport "
                 "has no extra dependency"
             )
+        idle = getattr(
+            args, "grpc_idle_timeout", GenomicsConfig.grpc_idle_timeout
+        )
         return GrpcVariantSource(
             args.api_url,
             credentials=get_access_token(args.client_secrets),
+            idle_timeout=idle if idle else None,
+            retry_policy=retry_policy,
+            breakers=breakers(f"grpc:{args.api_url}:"),
         )
     return HttpVariantSource(
         args.api_url,
         credentials=get_access_token(args.client_secrets),
         cache_dir=getattr(args, "cache_dir", None),
         mirror_mode=getattr(args, "mirror_mode", "full"),
+        retry_policy=retry_policy,
+        breakers=breakers(f"http:{args.api_url}:"),
     )
 
 
@@ -566,26 +599,40 @@ def _enable_compile_cache() -> None:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
-    outs = {
-        name: getattr(args, name, None)
-        for name in ("trace_out", "metrics_out", "manifest_out")
-    }
-    if not any(outs.values()):
-        return args.fn(args)
-    # One telemetry session per CLI run: spans/metrics collected by the
-    # ambient helpers everywhere below, artifacts written on exit — on
-    # the failure path too, so a crashed run leaves its timeline behind.
-    # (build_manifest drops non-JSON-serializable config values itself.)
-    from spark_examples_tpu.obs import telemetry_session
+    import contextlib
 
-    config = {
-        k: v for k, v in sorted(vars(args).items()) if k != "fn"
-    }
-    with telemetry_session(
-        command=args.command, config=config, **outs
-    ):
-        return args.fn(args)
+    from spark_examples_tpu.resilience import faults
+
+    args = build_parser().parse_args(argv)
+    # Deterministic fault plane: --fault-plan wins over the
+    # SPARK_EXAMPLES_TPU_FAULT_PLAN env var; either scopes the plan to
+    # this one command (chaos soaks drive the CLI exactly like a real
+    # run — docs/RESILIENCE.md).
+    spec = getattr(args, "fault_plan", None)
+    plan = (
+        faults.FaultPlan.from_spec(spec) if spec else faults.plan_from_env()
+    )
+    with faults.active_plan(plan) if plan else contextlib.nullcontext():
+        outs = {
+            name: getattr(args, name, None)
+            for name in ("trace_out", "metrics_out", "manifest_out")
+        }
+        if not any(outs.values()):
+            return args.fn(args)
+        # One telemetry session per CLI run: spans/metrics collected by
+        # the ambient helpers everywhere below, artifacts written on
+        # exit — on the failure path too, so a crashed run leaves its
+        # timeline behind. (build_manifest drops non-JSON-serializable
+        # config values itself.)
+        from spark_examples_tpu.obs import telemetry_session
+
+        config = {
+            k: v for k, v in sorted(vars(args).items()) if k != "fn"
+        }
+        with telemetry_session(
+            command=args.command, config=config, **outs
+        ):
+            return args.fn(args)
 
 
 if __name__ == "__main__":

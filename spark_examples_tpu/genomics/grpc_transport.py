@@ -72,6 +72,11 @@ def _identity(b: bytes) -> bytes:
     return b
 
 
+class _StreamIdleTimeout(IOError):
+    """Per-read idle deadline expired on a server stream: the peer is
+    connected (keepalive happy) but delivering nothing."""
+
+
 class _AuthInterceptor:
     """Bearer-token gate on every RPC (Client.scala:49-61 semantics)."""
 
@@ -178,7 +183,21 @@ class GrpcGenomicsServer:
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
         )
-        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        # Older grpcio returns 0 on bind failure (port already in use),
+        # newer raises RuntimeError; either way serve-cohort must never
+        # print 'grpc://host:0' and look healthy while no endpoint
+        # exists — normalize both shapes to a loud IOError.
+        try:
+            self.port = self._server.add_insecure_port(f"{host}:{port}")
+        except RuntimeError as e:
+            raise IOError(
+                f"cannot bind gRPC endpoint {host}:{port}: {e}"
+            ) from e
+        if self.port == 0 and port != 0:
+            raise IOError(
+                f"cannot bind gRPC endpoint {host}:{port} "
+                "(port already in use?)"
+            )
 
     def start(self) -> "GrpcGenomicsServer":
         self._server.start()
@@ -305,12 +324,32 @@ class GrpcVariantSource:
         credentials: Optional[Credentials] = None,
         stats: Optional[IoStats] = None,
         timeout: float = 60.0,
+        idle_timeout: Optional[float] = 120.0,
+        retry_policy=None,
+        breakers=None,
     ):
         import grpc
+
+        from spark_examples_tpu.resilience import BreakerSet, RetryPolicy
 
         if target.startswith("grpc://"):
             target = target[len("grpc://"):]
         self._grpc = grpc
+        # ``idle_timeout`` bounds the wait for EACH stream message —
+        # the liveness check keepalive cannot provide: keepalive pings
+        # detect a dead PEER, but a connected peer wedged inside its
+        # handler (a hung disk read server-side) answers pings forever
+        # while delivering nothing. Per-read idling is the HTTP
+        # source's socket-timeout semantics brought to gRPC; a long
+        # actively-delivering shard still never dies (each message
+        # resets the clock). None disables.
+        self._idle_timeout = idle_timeout
+        self._retry_policy = (
+            retry_policy if retry_policy is not None else RetryPolicy()
+        )
+        self._breakers = (
+            breakers if breakers is not None else BreakerSet(f"grpc:{target}")
+        )
         # Keepalive pings give streams TRANSPORT-level liveness detection
         # (a dead peer surfaces as UNAVAILABLE) without a whole-RPC
         # deadline: ``timeout`` here bounds UNARY calls only — a gRPC
@@ -342,6 +381,12 @@ class GrpcVariantSource:
         import grpc
 
         from spark_examples_tpu.obs import rpc_timer
+        from spark_examples_tpu.resilience import (
+            CircuitOpenError,
+            call_with_retry,
+            classify_grpc,
+            faults,
+        )
 
         fn = self._channel.unary_unary(
             f"/{_SERVICE}/{method}",
@@ -349,18 +394,36 @@ class GrpcVariantSource:
             response_deserializer=_identity,
         )
         self.stats.add(requests=1)
-        try:
+
+        def attempt() -> bytes:
+            faults.inject("transport.grpc.request", key=method)
             with rpc_timer("grpc", method):
                 return fn(
                     json.dumps(request).encode(),
                     metadata=self._metadata(),
                     timeout=self._timeout,
                 )
+
+        try:
+            return call_with_retry(
+                attempt,
+                self._retry_policy,
+                classify_grpc,
+                transport="grpc",
+                method=method,
+                breaker=self._breakers.get(method),
+            )
         except grpc.RpcError as e:
+            # Stats count ONCE at the final failure (retried attempts
+            # show on the obs surfaces), preserving the accumulator
+            # semantics the transport tests pin.
             self._count_rpc_error(e)
             raise IOError(
                 f"{method}: {e.code().name}: {e.details()}"
             ) from e
+        except (CircuitOpenError, faults.InjectedFault):
+            self.stats.add(io_exceptions=1)
+            raise
 
     def _count_rpc_error(self, e) -> None:
         import grpc
@@ -379,10 +442,74 @@ class GrpcVariantSource:
         else:
             self.stats.add(unsuccessful_responses=1)
 
+    def _iter_with_idle_timeout(
+        self, call, method: str
+    ) -> Iterator[bytes]:
+        """Pull stream messages with a per-READ idle deadline.
+
+        The gRPC iterator blocks in native code, so the wait cannot be
+        interrupted in-thread; a pump thread feeds a queue and the
+        consumer bounds each get. On idle expiry the RPC is cancelled
+        (the pump unblocks with CANCELLED and exits) and an IOError
+        surfaces — the wedged-but-connected-peer case keepalive alone
+        cannot catch.
+        """
+        if not self._idle_timeout:
+            yield from call
+            return
+        import queue as _queue
+        import threading
+
+        q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        done = object()
+
+        def pump() -> None:
+            try:
+                for msg in call:
+                    q.put(msg)
+                q.put(done)
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                q.put(e)
+
+        threading.Thread(
+            target=pump, name=f"grpc-pump-{method}", daemon=True
+        ).start()
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=self._idle_timeout)
+                except _queue.Empty:
+                    raise _StreamIdleTimeout(
+                        f"{method}: no stream message for "
+                        f"{self._idle_timeout}s (peer connected but "
+                        "wedged); cancelled the RPC"
+                    )
+                if item is done:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # Unblocks the pump thread on every exit path — idle
+            # expiry, a consumer abandoning the stream (GeneratorExit),
+            # or normal exhaustion (where cancel is a no-op): without
+            # this the pump's reference would hold an abandoned RPC
+            # open indefinitely.
+            call.cancel()
+
     def _stream(self, method: str, request: dict) -> Iterator[bytes]:
+        import time as _time
+
         import grpc
 
         from spark_examples_tpu.obs import rpc_timer
+        from spark_examples_tpu import obs
+        from spark_examples_tpu.resilience import (
+            Budget,
+            RetryDecision,
+            classify_grpc,
+            faults,
+        )
 
         fn = self._channel.unary_stream(
             f"/{_SERVICE}/{method}",
@@ -390,25 +517,137 @@ class GrpcVariantSource:
             response_deserializer=_identity,
         )
         self.stats.add(requests=1)
-        try:
-            # No deadline on streams (see __init__): liveness comes from
-            # channel keepalive, so a slow-but-flowing shard never dies
-            # at an arbitrary total-wall-clock cutoff. The latency
-            # histogram times the WHOLE stream (call → exhaustion): the
-            # per-shard decomposition stall diagnosis needs.
-            with rpc_timer("grpc", method):
-                yield from fn(
-                    json.dumps(request).encode(),
-                    metadata=self._metadata(),
+        payload = json.dumps(request).encode()
+        breaker = self._breakers.get(method)
+        # The policy's wall-clock budget bounds the stream-START retry
+        # loop exactly as it bounds unary calls (--rpc-retry-deadline's
+        # contract); the stream BODY stays unbounded on purpose — see
+        # __init__ on why a total deadline would kill healthy shards.
+        budget = Budget(self._retry_policy.deadline)
+        failures = 0
+        while True:
+            # Stream-start retry: until the FIRST message is out, the
+            # request is safely re-issuable (nothing was consumed).
+            # After that, a failure must surface — the shard-ingest
+            # retry layer owns whole-stream re-execution.
+            yielded = False
+            # Probe accounting: a half-open probe admitted here must be
+            # closed by exactly one verdict; a consumer abandoning the
+            # stream mid-probe (GeneratorExit) gives none, so the slot
+            # is released in the finally below instead of leaking.
+            verdict_given = False
+            try:
+                breaker.before_call()
+            except IOError:  # CircuitOpenError: the endpoint is shedding
+                self.stats.add(io_exceptions=1)
+                raise
+            try:
+                # No total deadline on stream bodies: liveness comes
+                # from keepalive + the per-read idle timeout, so a
+                # slow-but-flowing shard never dies at an arbitrary
+                # total-wall-clock cutoff. The latency histogram times
+                # the WHOLE stream (call → exhaustion): the per-shard
+                # decomposition stall diagnosis needs.
+                with rpc_timer("grpc", method):
+                    faults.inject("transport.grpc.request", key=method)
+                    call = fn(payload, metadata=self._metadata())
+                    for msg in faults.wrap_lines(
+                        "transport.grpc.stream",
+                        self._iter_with_idle_timeout(call, method),
+                        key=method,
+                        # No end sentinel on this wire: a silent early
+                        # end would DROP records undetectably, which no
+                        # real gRPC failure can do (truncation is a
+                        # status here) — inject it as an error instead.
+                        truncate_silently=False,
+                    ):
+                        yielded = True
+                        yield msg
+                breaker.record_success()
+                verdict_given = True
+                return
+            except grpc.RpcError as e:
+                # Includes mid-stream aborts: gRPC's framing makes a
+                # broken stream a STATUS, never a silent truncation —
+                # the property the HTTP framing layer hand-rolls with
+                # its end frame.
+                decision = classify_grpc(e)
+                if decision.retryable:
+                    breaker.record_failure()
+                else:
+                    breaker.record_success()  # the endpoint ANSWERED
+                verdict_given = True
+                failures += 1
+                if (
+                    not yielded
+                    and decision.retryable
+                    and failures < max(1, self._retry_policy.max_attempts)
+                    and not budget.exhausted()
+                ):
+                    self._note_stream_retry(method, failures, decision)
+                    _time.sleep(
+                        min(
+                            self._retry_policy.backoff_delay(failures),
+                            max(0.0, budget.remaining()),
+                        )
+                    )
+                    continue
+                self._count_rpc_error(e)
+                raise IOError(
+                    f"{method}: {e.code().name}: {e.details()}"
+                ) from e
+            except (_StreamIdleTimeout, faults.InjectedFault) as e:
+                breaker.record_failure()
+                verdict_given = True
+                failures += 1
+                # A fault injected at the REQUEST seam is transport
+                # weather and re-issuable exactly like an UNAVAILABLE
+                # (the unary path classifies it the same way);
+                # mid-stream conditions (idle timeout, stream-body
+                # faults) surface to the shard layer.
+                if (
+                    isinstance(e, faults.InjectedFault)
+                    and e.site == "transport.grpc.request"
+                    and not yielded
+                    and failures < max(1, self._retry_policy.max_attempts)
+                    and not budget.exhausted()
+                ):
+                    self._note_stream_retry(
+                        method, failures, RetryDecision(True, "injected")
+                    )
+                    _time.sleep(
+                        min(
+                            self._retry_policy.backoff_delay(failures),
+                            max(0.0, budget.remaining()),
+                        )
+                    )
+                    continue
+                self.stats.add(io_exceptions=1)
+                obs.instant(
+                    "grpc_stream_idle_timeout"
+                    if isinstance(e, _StreamIdleTimeout)
+                    else "grpc_stream_fault",
+                    scope="p",
+                    method=method,
+                    error=repr(e),
                 )
-        except grpc.RpcError as e:
-            # Includes mid-stream aborts: gRPC's framing makes a broken
-            # stream a STATUS, never a silent truncation — the property
-            # the HTTP framing layer hand-rolls with its end frame.
-            self._count_rpc_error(e)
-            raise IOError(
-                f"{method}: {e.code().name}: {e.details()}"
-            ) from e
+                raise IOError(f"{method}: {e}") from e
+            finally:
+                if not verdict_given:
+                    breaker.release_probe()
+
+    def _note_stream_retry(self, method: str, attempt: int, decision):
+        from spark_examples_tpu import obs
+
+        obs.count_retry("grpc", method)
+        obs.instant(
+            "retry_backoff",
+            scope="p",
+            transport="grpc",
+            method=method,
+            attempt=attempt,
+            reason=decision.reason,
+        )
 
     def compute_pca(
         self, calls, n_samples: int, num_pc: int, batch_size: int = 4096

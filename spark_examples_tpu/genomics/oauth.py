@@ -39,15 +39,31 @@ def exchange_refresh_token(
     refresh_token: str,
     token_uri: str = GOOGLE_TOKEN_URI,
     timeout: float = 30.0,
+    retry_policy=None,
 ) -> str:
     """POST the refresh-token grant; return the live access token.
 
     Raises :class:`~spark_examples_tpu.genomics.auth.AuthError` with the
     endpoint's ``error``/``error_description`` on a denial — surfacing
     "invalid_grant: token revoked" beats a bare 400.
+
+    The exchange runs under the shared retry engine with the OAUTH
+    classification table (``resilience.classify_oauth``): transport
+    trouble and 5xx/429 retry with backoff — the grant is idempotent —
+    while 4xx denials (``invalid_grant`` & co, RFC 6749 §5.2) surface
+    immediately: a revoked token never un-revokes, and hammering the
+    token endpoint over one only invites throttling.
     """
     from spark_examples_tpu.genomics.auth import AuthError
+    from spark_examples_tpu.resilience import (
+        RetryPolicy,
+        call_with_retry,
+        classify_oauth,
+        faults,
+    )
 
+    if retry_policy is None:
+        retry_policy = RetryPolicy(max_attempts=3, base_delay=0.2)
     form = urlencode(
         {
             "grant_type": "refresh_token",
@@ -61,9 +77,24 @@ def exchange_refresh_token(
         data=form,
         headers={"Content-Type": "application/x-www-form-urlencoded"},
     )
-    try:
+
+    def attempt():
+        faults.inject("transport.oauth.request", key=token_uri)
         with urlopen(req, timeout=timeout) as resp:
-            payload = json.load(resp)
+            return json.load(resp)
+
+    try:
+        payload = call_with_retry(
+            attempt,
+            retry_policy,
+            classify_oauth,
+            transport="oauth",
+            method="token",
+        )
+    except faults.InjectedFault as e:
+        raise AuthError(
+            f"cannot reach token endpoint {token_uri}: {e}"
+        ) from e
     except HTTPError as e:
         # OAuth error responses are JSON bodies on 4xx (RFC 6749 §5.2).
         try:

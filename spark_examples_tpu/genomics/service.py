@@ -66,11 +66,16 @@ _END_FRAME = b"e"
 class _ServedHttpError(Exception):
     """Carrier for a served HTTP error status (the urllib.HTTPError
     analog for the keep-alive http.client path): _http_code reads
-    ``.code`` off an IOError's cause regardless of transport."""
+    ``.code`` off an IOError's cause regardless of transport, and the
+    retry classifier reads ``.retry_after`` (parsed Retry-After header
+    seconds) to honor server-directed backoff."""
 
-    def __init__(self, code: int, reason: str):
+    def __init__(
+        self, code: int, reason: str, retry_after: Optional[float] = None
+    ):
         super().__init__(f"HTTP {code} {reason}")
         self.code = code
+        self.retry_after = retry_after
 
 
 def _http_code(exc: IOError) -> Optional[int]:
@@ -387,11 +392,15 @@ class HttpVariantSource:
         timeout: float = 60.0,
         cache_dir: Optional[str] = None,
         mirror_mode: str = "full",
+        retry_policy=None,
+        breakers=None,
     ):
         if mirror_mode not in ("full", "light"):
             raise ValueError(
                 f"mirror_mode must be 'full' or 'light', got {mirror_mode!r}"
             )
+        from spark_examples_tpu.resilience import BreakerSet, RetryPolicy
+
         self.base_url = base_url.rstrip("/")
         self._url = urlparse(self.base_url)
         self._token = credentials.token if credentials else ""
@@ -399,6 +408,20 @@ class HttpVariantSource:
         self._timeout = timeout
         self._cache_dir = cache_dir
         self._mirror_mode = mirror_mode
+        # Declarative failure handling (resilience/policy.py): every
+        # request runs under the policy — transport errors and
+        # infrastructural statuses (429/502/503/504...) retry with
+        # jittered backoff and Retry-After honoring; per-PATH circuit
+        # breakers shed load from a down endpoint instead of burning
+        # each shard's full attempt budget against it.
+        self._retry_policy = (
+            retry_policy if retry_policy is not None else RetryPolicy()
+        )
+        self._breakers = (
+            breakers
+            if breakers is not None
+            else BreakerSet(f"http:{self._url.netloc}")
+        )
         self._mirror = None  # resolved lazily: JsonlSource | False | None
         # Shard-parallel ingest resolves the mirror from worker threads;
         # the download must happen exactly once, not raced.
@@ -436,11 +459,69 @@ class HttpVariantSource:
                 pass
             self._conns.conn = None
 
-    def _request(self, path: str, params: dict, stream: bool = False):
+    def _one_attempt(self, path: str, target: str, headers: dict):
+        """One wire round-trip: returns the response or raises IOError
+        (transport trouble or a served error status, distinguishable by
+        :func:`_http_code`). Per-ATTEMPT latency samples: one
+        observation = one round-trip, the same unit the gRPC tier
+        records, so the transports' histograms compare like for like."""
         import http.client
         import time as _time
 
         from spark_examples_tpu import obs
+        from spark_examples_tpu.resilience import faults, policy
+
+        t0 = _time.perf_counter()
+        try:
+            # Injection BEFORE the socket write: a fired fault is
+            # indistinguishable from real transport weather downstream.
+            faults.inject("transport.http.request", key=path)
+            conn = self._connection()
+            conn.request("GET", target, headers=headers)
+            resp = conn.getresponse()
+        except (http.client.HTTPException, OSError) as e:
+            # A kept-alive socket the server closed between requests
+            # fails exactly here — drop it so the next attempt (the
+            # policy's call, not ours) reconnects fresh.
+            self._drop_connection()
+            obs.observe_rpc(
+                "http", path, _time.perf_counter() - t0, error=True
+            )
+            raise IOError(f"{path}: {e}") from e
+        if resp.status >= 300:
+            # A served error response (401/404/500): the reference
+            # counts these as unsuccessfulResponses (Client.scala:59).
+            # 3xx is an error too, ON PURPOSE: this client does not
+            # follow redirects (the urllib predecessor silently did),
+            # and handing a redirect body to the frame parser yields
+            # the misleading "unframed line" diagnosis — point
+            # --api-url at the service's final URL instead.
+            reason = resp.reason
+            code = resp.status
+            retry_after = policy.parse_retry_after(
+                resp.headers.get("Retry-After")
+            )
+            try:
+                resp.read()  # drain so the connection stays reusable
+            except (http.client.HTTPException, OSError):
+                self._drop_connection()
+            obs.observe_rpc(
+                "http", path, _time.perf_counter() - t0, error=True
+            )
+            raise IOError(f"{path}: HTTP {code} {reason}") from (
+                _ServedHttpError(code, reason, retry_after)
+            )
+        # Header-phase latency: the time to a served response. Shard
+        # stream *bodies* are timed by the callers that consume them.
+        obs.observe_rpc("http", path, _time.perf_counter() - t0)
+        return resp
+
+    def _request(self, path: str, params: dict, stream: bool = False):
+        from spark_examples_tpu.resilience import (
+            CircuitOpenError,
+            call_with_retry,
+            classify_http,
+        )
 
         target = self._url.path + path
         if params:
@@ -455,57 +536,27 @@ class HttpVariantSource:
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
         self.stats.add(requests=1)
-        for attempt in (0, 1):
-            # Per-ATTEMPT latency samples: one observation = one wire
-            # round-trip, the same unit the gRPC tier records, so the
-            # transports' histograms compare like for like.
-            t0 = _time.perf_counter()
-            conn = self._connection()
-            try:
-                conn.request("GET", target, headers=headers)
-                resp = conn.getresponse()
-            except (http.client.HTTPException, OSError) as e:
-                # A kept-alive socket the server closed between requests
-                # fails exactly here — reconnect once before concluding
-                # transport trouble.
-                self._drop_connection()
-                obs.observe_rpc(
-                    "http", path, _time.perf_counter() - t0, error=True
-                )
-                if attempt == 0:
-                    obs.count_retry("http", path)
-                    obs.instant(
-                        "http_reconnect_retry", path=path, error=repr(e)
-                    )
-                    continue
+        try:
+            return call_with_retry(
+                lambda: self._one_attempt(path, target, headers),
+                self._retry_policy,
+                classify_http,
+                transport="http",
+                method=path,
+                breaker=self._breakers.get(path),
+            )
+        except IOError as e:
+            # IoStats counting happens ONCE, at the final failure —
+            # retried attempts are visible on the obs surfaces instead,
+            # keeping the reference's accumulator semantics
+            # (Client.scala:57-61): served status → unsuccessful
+            # response, anything transport-shaped (breaker sheds
+            # included) → io exception.
+            if isinstance(e, CircuitOpenError) or _http_code(e) is None:
                 self.stats.add(io_exceptions=1)
-                raise IOError(f"{path}: {e}") from e
-            if resp.status >= 300:
-                # A served error response (401/404/500): the reference
-                # counts these as unsuccessfulResponses (Client.scala:59).
-                # 3xx is an error too, ON PURPOSE: this client does not
-                # follow redirects (the urllib predecessor silently did),
-                # and handing a redirect body to the frame parser yields
-                # the misleading "unframed line" diagnosis — point
-                # --api-url at the service's final URL instead.
+            else:
                 self.stats.add(unsuccessful_responses=1)
-                reason = resp.reason
-                code = resp.status
-                try:
-                    resp.read()  # drain so the connection stays reusable
-                except (http.client.HTTPException, OSError):
-                    self._drop_connection()
-                obs.observe_rpc(
-                    "http", path, _time.perf_counter() - t0, error=True
-                )
-                raise IOError(f"{path}: HTTP {code} {reason}") from (
-                    _ServedHttpError(code, reason)
-                )
-            # Header-phase latency: the time to a served response. Shard
-            # stream *bodies* are timed by the callers that consume them.
-            obs.observe_rpc("http", path, _time.perf_counter() - t0)
-            return resp
-        raise AssertionError("unreachable")  # loop always returns/raises
+            raise
 
     # -- cohort mirror cache ------------------------------------------------
 
@@ -558,25 +609,65 @@ class HttpVariantSource:
         # the gate re-fire after any interrupted upgrade — fetching
         # variants first would mark the mirror "full" with reads.jsonl
         # permanently missing.
-        for name in ("reads.jsonl", "variants.jsonl"):
-            if os.path.exists(os.path.join(root, name)):
-                continue
-            try:
-                resp = self._request(f"/export/{name}", {}, stream=True)
-            except IOError as e:
-                if name == "reads.jsonl" and _http_code(e) == 404:
-                    continue  # reads are optional in the layout
-                raise
-            tmp = os.path.join(root, f".partial-{name}-{os.getpid()}")
-            try:
+        staged = []  # (tmp path, final name), commit-ordered
+        try:
+            for name in ("reads.jsonl", "variants.jsonl"):
+                if os.path.exists(os.path.join(root, name)):
+                    continue
+                try:
+                    resp = self._request(
+                        f"/export/{name}", {}, stream=True
+                    )
+                except IOError as e:
+                    if name == "reads.jsonl" and _http_code(e) == 404:
+                        continue  # reads are optional in the layout
+                    raise
+                tmp = os.path.join(
+                    root, f".partial-{name}-{os.getpid()}"
+                )
+                staged.append((tmp, name))
                 with open(tmp, "wb") as out:
                     for line in self._stream_lines(
                         resp, f"/export/{name}"
                     ):
                         out.write(line)
                         out.write(b"\n")
+            if not staged:
+                return
+            # The upgrade downloaded over a window in which the server
+            # cohort may have CHANGED — the same TOCTOU window
+            # _download_mirror re-verifies. At all-autosomes scale the
+            # download runs for hours; a mid-upgrade cohort swap would
+            # leave the OLD sidecar (vouched forever by .sidecar-ok)
+            # next to NEW JSONL, and the fused/CSR tier and the
+            # record-streaming tier would silently serve different
+            # cohorts. Verify BEFORE committing anything: files land in
+            # the mirror only after /identity still matches the pin, so
+            # a failure anywhere in this window leaves the prior light
+            # mirror untouched (never unverified files that a later run
+            # would trust forever).
+            expect = None
+            try:
+                with open(os.path.join(root, MIRROR_IDENTITY_FILE)) as f:
+                    expect = f.read().strip()
+            except OSError:
+                pass  # mirrors always carry it; no pin → can't verify
+            with self._request("/identity", {}) as resp:
+                now_ident = json.load(resp)["identity"]
+            if expect is not None and now_ident != expect:
+                raise IOError(
+                    "server cohort changed while upgrading mirror "
+                    f"(identity {expect} -> {now_ident}); the upgrade "
+                    "was discarded — rerun to mirror the new cohort"
+                )
+            # Commit order (reads before variants, the staged list's
+            # order): variants.jsonl's presence is the upgrade gate, so
+            # replacing it LAST makes the gate re-fire after a crash
+            # between the two commits.
+            for tmp, name in staged:
                 os.replace(tmp, os.path.join(root, name))
-            finally:
+        finally:
+            for tmp, _ in staged:
                 try:
                     os.unlink(tmp)
                 except OSError:
@@ -776,11 +867,19 @@ class HttpVariantSource:
         import http.client
         import zlib
 
+        from spark_examples_tpu.resilience import faults
+
         complete = False
         unframed = False
         try:
             with resp:
-                for line in _decoded_lines(resp):
+                # Chaos seam: stream-shaped faults (truncate/corrupt/
+                # stall/error) applied to the wire lines land HERE, so
+                # the framing layer's defenses are what detects them —
+                # exactly as they would a real proxy cutoff.
+                for line in faults.wrap_lines(
+                    "transport.http.stream", _decoded_lines(resp), key=path
+                ):
                     line = line.rstrip(b"\r\n")
                     if not line:
                         continue

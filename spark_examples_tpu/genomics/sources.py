@@ -371,6 +371,27 @@ class _SortedIndex:
         return items[lo:hi]
 
 
+class _FailOnceShards:
+    """Adapter keeping the historical fail-once surface
+    (``src._fail_once.add(shard)``) on the resilience fault plane: each
+    added shard becomes a one-shot error rule on the source's plan."""
+
+    def __init__(self, plan):
+        self._plan = plan
+
+    def add(self, shard) -> None:
+        from spark_examples_tpu.resilience import FaultRule
+
+        self._plan.add_rule(
+            FaultRule(
+                site="fixture.stream",
+                kind="error",
+                times=1,
+                match=str(shard),
+            )
+        )
+
+
 class FixtureSource:
     """In-memory fake genomics service.
 
@@ -393,10 +414,18 @@ class FixtureSource:
         self._callsets = list(callsets)
         self._reads = list(reads)
         self.stats = stats if stats is not None else IoStats()
-        # Fault injection: shards that raise on first stream attempt —
-        # exercises the retry/elasticity path the reference delegates to
-        # Spark task re-execution.
-        self._fail_once = set(fail_shards)
+        # Fault injection rides the resilience fault plane (a per-source
+        # FaultPlan at site "fixture.stream"): ``fail_shards`` become
+        # one-shot error rules keyed by shard, exercising the
+        # retry/elasticity path the reference delegates to Spark task
+        # re-execution. ``_fail_once`` keeps the historical add()-a-shard
+        # surface as a thin adapter over the plan.
+        from spark_examples_tpu.resilience import FaultPlan
+
+        self.faults = FaultPlan()
+        self._fail_once = _FailOnceShards(self.faults)
+        for shard in fail_shards:
+            self._fail_once.add(shard)
         self._variant_idx: Optional[_SortedIndex] = None
         self._read_idx: Optional[_SortedIndex] = None
         self._identity: Optional[str] = None
@@ -430,10 +459,14 @@ class FixtureSource:
         self.stats.add(
             partitions=1, requests=1, reference_bases=shard.range
         )
-        if shard in self._fail_once:
-            self._fail_once.discard(shard)
+        try:
+            # Per-source fault plane (see __init__): one-shot fail_shards
+            # rules plus whatever a test registered directly on
+            # ``self.faults``.
+            self.faults.inject("fixture.stream", key=str(shard))
+        except IOError as e:
             self.stats.add(io_exceptions=1)
-            raise IOError(f"injected stream failure for {shard}")
+            raise IOError(f"injected stream failure for {shard}") from e
         if self._variant_idx is None:
             # One-time whole-cohort index build: its own span, NOT a
             # latency sample — folding it into the first shard's
@@ -913,15 +946,31 @@ def csr_pair_from_lists(lists) -> Optional[tuple]:
 def _line_vsid_matches(line: bytes, variant_set_id: str) -> bool:
     """The one variant-set rule (see _carrying_records) applied to a raw
     interchange line: falsy stored id is a wildcard, non-empty must
-    equal. Byte scan with a json.loads fallback on shape surprises."""
+    equal. Byte scan with a json.loads fallback on shape surprises.
+
+    TOP-LEVEL GUARD (the same rule _extract_fields applies): a key
+    match past the record's first nested container could be a
+    "variant_set_id" key INSIDE calls/info — trusting it would make
+    this zero-parse path filter records differently from the parsed
+    path's top-level ``rec.get("variant_set_id")``. Any match beyond
+    that point falls back to the real parse instead.
+    """
     if not variant_set_id:
         return True
     i = line.find(b'"variant_set_id"')
     if i < 0:
         return True  # absent → wildcard
-    stored = _scan_json_string(line, b'"variant_set_id"')
-    if stored is None:
+    nested = len(line)
+    for tok in (b"[", b"{"):
+        p = line.find(tok, 1)  # skip the record's own opening brace
+        if p >= 0:
+            nested = min(nested, p)
+    if i > nested:
         stored = json.loads(line).get("variant_set_id")
+    else:
+        stored = _scan_json_string(line, b'"variant_set_id"')
+        if stored is None:
+            stored = json.loads(line).get("variant_set_id")
     return not stored or stored == variant_set_id
 
 

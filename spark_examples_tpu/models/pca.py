@@ -221,6 +221,39 @@ class VariantsPcaDriver:
             return self.conf.ingest_workers
         return min(os.cpu_count() or 1, 16)
 
+    def _shard_attempt(self, shard, fn):
+        """Run one idempotent shard extraction under the resilience
+        layer: up to ``--shard-retries`` attempts, each drawing down the
+        per-shard ``--shard-retry-deadline`` budget, with the
+        ``ingest.shard`` fault-plane seam in front (worker death = an
+        injected error, a slow lane = an injected stall). Re-execution
+        is sound because the manifest is deterministic and per-shard
+        ingest idempotent (STRICT boundaries) — a retried shard yields
+        byte-identical call lists, so results never change, only
+        wall-clock. Default (1 attempt, no plan) adds zero overhead."""
+        from spark_examples_tpu import resilience
+        from spark_examples_tpu.resilience import faults
+
+        retries = max(1, getattr(self.conf, "shard_retries", 1))
+        if retries <= 1 and faults.current_plan() is None:
+            return fn()
+
+        def attempt():
+            faults.inject("ingest.shard", key=str(shard))
+            return fn()
+
+        return resilience.call_with_retry(
+            attempt,
+            resilience.RetryPolicy(
+                max_attempts=retries,
+                base_delay=0.05,
+                deadline=getattr(self.conf, "shard_retry_deadline", None),
+            ),
+            resilience.classify_ingest,
+            transport="ingest",
+            method="shard",
+        )
+
     def _parallel_shard_calls(
         self, vsid: str, shards, stream_method=None, workers=None
     ):
@@ -237,13 +270,16 @@ class VariantsPcaDriver:
         method = stream_method or self.source.stream_carrying
 
         def extract(shard):
-            return list(
-                method(
-                    vsid,
-                    shard,
-                    self.index.indexes,
-                    self.conf.min_allele_frequency,
-                )
+            return self._shard_attempt(
+                shard,
+                lambda: list(
+                    method(
+                        vsid,
+                        shard,
+                        self.index.indexes,
+                        self.conf.min_allele_frequency,
+                    )
+                ),
             )
 
         def note_speculation(shard):
@@ -298,11 +334,14 @@ class VariantsPcaDriver:
             )
 
         def extract(shard):
-            return self.source.stream_carrying_csr(
-                vsid,
+            return self._shard_attempt(
                 shard,
-                self.index.indexes,
-                self.conf.min_allele_frequency,
+                lambda: self.source.stream_carrying_csr(
+                    vsid,
+                    shard,
+                    self.index.indexes,
+                    self.conf.min_allele_frequency,
+                ),
             )
 
         yield from ordered_parallel_map(
@@ -1033,11 +1072,14 @@ class VariantsPcaDriver:
             from spark_examples_tpu.arrays.blocks import blocks_from_csr
 
             pairs = (
-                self.source.stream_carrying_csr(
-                    vsid,
+                self._shard_attempt(
                     shard,
-                    self.index.indexes,
-                    self.conf.min_allele_frequency,
+                    lambda shard=shard: self.source.stream_carrying_csr(
+                        vsid,
+                        shard,
+                        self.index.indexes,
+                        self.conf.min_allele_frequency,
+                    ),
                 )
                 for shard in group
             )
@@ -1052,10 +1094,24 @@ class VariantsPcaDriver:
                 yield from self._parallel_shard_calls(vsid, group)
                 return
             for shard in group:
-                stream = self.filter_dataset(
-                    self.source.stream_variants(vsid, shard)
+                # Materialize per shard so the retry layer can re-execute
+                # a failed shard without re-running its predecessors —
+                # one shard's call lists, bounded memory.
+                yield from self._shard_attempt(
+                    shard,
+                    lambda shard=shard: list(
+                        calls_stream(
+                            [
+                                self.filter_dataset(
+                                    self.source.stream_variants(
+                                        vsid, shard
+                                    )
+                                )
+                            ],
+                            self.index.indexes,
+                        )
+                    ),
                 )
-                yield from calls_stream([stream], self.index.indexes)
 
         blocks = blocks_from_calls(
             group_calls(), self.index.size, self.conf.block_variants

@@ -1,0 +1,84 @@
+"""Unified resilience layer: retry policies, circuit breakers, faults.
+
+Three pillars, adopted by every failure-bearing tier (HTTP, gRPC,
+oauth, shard ingest, checkpoint/lane IO):
+
+1. **Retry-policy engine** (:mod:`.policy`): declarative
+   :class:`RetryPolicy` (jittered exponential backoff, attempt cap,
+   wall-clock deadline budget, Retry-After honoring) run through ONE
+   loop (:func:`call_with_retry`) with per-transport retryable-error
+   classifiers — replacing the ad-hoc per-tier loops.
+2. **Circuit breaker** (:mod:`.breaker`): per-endpoint
+   closed/open/half-open state machines that shed load from a failing
+   tier and probe for recovery, fed only by *retryable* failures.
+3. **Fault-injection plane** (:mod:`.faults`): deterministic, seedable
+   :class:`FaultPlan` activated via CLI/env, with injection points at
+   transport, shard ingest, and checkpoint/lane seams. The chaos
+   harness (``tests/test_resilience.py``) runs the full CPU pipeline
+   under seeded plans and pins results numerically identical to the
+   fault-free run.
+
+Everything is observable: retries, breaker transitions, and injected
+faults all land on the PR-1 obs timeline and metrics registry, so the
+artifacts ``scripts/validate_trace.py`` checks tell the failure story.
+"""
+
+from spark_examples_tpu.resilience.policy import (
+    Budget,
+    RETRYABLE_HTTP_STATUS,
+    RETRYABLE_OAUTH_STATUS,
+    RetryDecision,
+    RetryPolicy,
+    call_with_retry,
+    classify_grpc,
+    classify_http,
+    classify_ingest,
+    classify_oauth,
+)
+from spark_examples_tpu.resilience.breaker import (
+    BreakerSet,
+    CircuitBreaker,
+    CircuitOpenError,
+)
+from spark_examples_tpu.resilience.faults import (
+    FAULT_PLAN_ENV,
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    active_plan,
+    clear_plan,
+    current_plan,
+    inject,
+    install_plan,
+    plan_from_env,
+    take,
+    wrap_lines,
+)
+
+__all__ = [
+    "Budget",
+    "RETRYABLE_HTTP_STATUS",
+    "RETRYABLE_OAUTH_STATUS",
+    "RetryDecision",
+    "RetryPolicy",
+    "call_with_retry",
+    "classify_grpc",
+    "classify_http",
+    "classify_ingest",
+    "classify_oauth",
+    "BreakerSet",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "FAULT_PLAN_ENV",
+    "FaultPlan",
+    "FaultRule",
+    "InjectedFault",
+    "active_plan",
+    "clear_plan",
+    "current_plan",
+    "inject",
+    "install_plan",
+    "plan_from_env",
+    "take",
+    "wrap_lines",
+]

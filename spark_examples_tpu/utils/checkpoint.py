@@ -43,12 +43,57 @@ _SNAP = "gramian_snapshot.npz"
 _SHARDED_SNAP = "gramian_sharded_snapshot.npz"
 
 
+def _warn_unreadable(path: str, exc: BaseException) -> None:
+    import sys
+
+    print(
+        f"WARNING: unreadable Gramian snapshot {path} "
+        f"({type(exc).__name__}: {exc}); discarding — ingest restarts "
+        "from the last readable state.",
+        file=sys.stderr,
+    )
+    from spark_examples_tpu import obs
+
+    obs.instant(
+        "checkpoint_snapshot_unreadable", scope="p", path=path
+    )
+
+
 @dataclass(frozen=True)
 class GramianCheckpoint:
     g: np.ndarray
     shards_done: int
     run_digest: str
     n_samples: int
+
+
+def _apply_write_fault(site: str, path: str) -> None:
+    """Honor a fault-plane rule at a checkpoint write seam.
+
+    ``torn`` truncates the just-committed file to half its bytes —
+    simulating a torn write on a filesystem without atomic rename
+    (exactly what the tolerant loaders must survive); ``error``/
+    ``stall`` act as everywhere else. No-op without an active plan.
+    """
+    from spark_examples_tpu.resilience import faults
+
+    rule = faults.take(site, key=path)
+    if rule is None:
+        return
+    if rule.kind == "torn":
+        try:
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(max(1, size // 2))
+        except OSError:
+            pass
+        return
+    if rule.kind == "stall":
+        import time
+
+        time.sleep(rule.stall_s)
+        return
+    raise faults.InjectedFault(site, rule.kind, path, rule.message)
 
 
 def save_snapshot(
@@ -68,7 +113,9 @@ def save_snapshot(
             shards_done=np.int64(shards_done),
             run_digest=np.bytes_(run_digest.encode()),
         )
-    os.replace(tmp, os.path.join(directory, _SNAP))
+    path = os.path.join(directory, _SNAP)
+    os.replace(tmp, path)
+    _apply_write_fault("checkpoint.snapshot_write", path)
 
 
 def _encode_index(index, shape) -> np.ndarray:
@@ -131,17 +178,21 @@ def load_sharded_snapshot(
     if not os.path.exists(snap_path):
         return None
     tiles = {}
-    with np.load(snap_path) as z:
-        if (
-            bytes(z["run_digest"]).decode() != run_digest
-            or int(z["n"]) != n_samples
-        ):
-            return None
-        shards_done = int(z["shards_done"])
-        i = 0
-        while f"data_{i}" in z:
-            tiles[tuple(map(tuple, z[f"index_{i}"]))] = z[f"data_{i}"]
-            i += 1
+    try:
+        with np.load(snap_path) as z:
+            if (
+                bytes(z["run_digest"]).decode() != run_digest
+                or int(z["n"]) != n_samples
+            ):
+                return None
+            shards_done = int(z["shards_done"])
+            i = 0
+            while f"data_{i}" in z:
+                tiles[tuple(map(tuple, z[f"index_{i}"]))] = z[f"data_{i}"]
+                i += 1
+    except Exception as e:  # noqa: BLE001 — any torn-file shape
+        _warn_unreadable(snap_path, e)
+        return None
     return shards_done, tiles
 
 
@@ -156,10 +207,17 @@ def load_snapshot(
     snap_path = os.path.join(directory, _SNAP)
     if not os.path.exists(snap_path):
         return None
-    with np.load(snap_path) as z:
-        g = z["g"]
-        shards_done = int(z["shards_done"])
-        stored_digest = bytes(z["run_digest"]).decode()
+    try:
+        with np.load(snap_path) as z:
+            g = z["g"]
+            shards_done = int(z["shards_done"])
+            stored_digest = bytes(z["run_digest"]).decode()
+    except Exception as e:  # noqa: BLE001 — any torn-file shape
+        # The atomic-rename protocol cannot produce a torn snapshot, but
+        # a non-atomic filesystem (or a crash inside one) can. Resume
+        # must degrade to re-ingesting, never die on its own safety net.
+        _warn_unreadable(snap_path, e)
+        return None
     if stored_digest != run_digest or g.shape[0] != n_samples:
         return None
     return GramianCheckpoint(

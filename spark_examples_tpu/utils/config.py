@@ -15,6 +15,11 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from spark_examples_tpu.arrays.blocks import DEFAULT_BLOCK_VARIANTS
+from spark_examples_tpu.resilience.breaker import (
+    DEFAULT_COOLDOWN_S,
+    DEFAULT_FAILURE_THRESHOLD,
+)
+from spark_examples_tpu.resilience.policy import RetryPolicy as _RetryPolicy
 from spark_examples_tpu.genomics.shards import (
     BRCA1_REFERENCES,
     DEFAULT_BASES_PER_SHARD,
@@ -46,6 +51,17 @@ class GenomicsConfig:
     # TPU-native additions (replace --spark-master):
     mesh_shape: Optional[str] = None  # e.g. "data:4,model:2"
     block_variants: int = DEFAULT_BLOCK_VARIANTS
+    # Resilience layer (spark_examples_tpu.resilience): declarative
+    # retry policy for the network tiers (HTTP + gRPC), per-endpoint
+    # circuit breaking, and the deterministic fault-injection plane.
+    # Defaults derive from the layer itself (RetryPolicy / breaker
+    # constants) so dataclass, flags, and direct construction agree.
+    rpc_retries: int = _RetryPolicy.max_attempts  # attempts (1 = no retry)
+    rpc_retry_deadline: Optional[float] = None  # wall-clock budget (s)
+    breaker_threshold: int = DEFAULT_FAILURE_THRESHOLD
+    breaker_cooldown: float = DEFAULT_COOLDOWN_S
+    grpc_idle_timeout: Optional[float] = 120.0  # per-read stream idle (s)
+    fault_plan: Optional[str] = None  # FaultPlan JSON (inline or a path)
 
     def shards(
         self,
@@ -115,6 +131,12 @@ class PcaConfig(GenomicsConfig):
     # turns that into a loud exit-77 + snapshot resume (utils/watchdog.py).
     # None = disabled.
     collective_timeout: Optional[float] = None
+    # Per-shard ingest retry (the driver-side resilience tier): each
+    # shard extraction is idempotent, so failed shards re-execute up to
+    # this many total attempts, every attempt drawing down the per-shard
+    # wall-clock budget below. 1 = the historical fail-fast behavior.
+    shard_retries: int = 1
+    shard_retry_deadline: Optional[float] = None
     # Unified telemetry artifacts (spark_examples_tpu.obs): Chrome-trace
     # span timeline, Prometheus metrics dump (+ .jsonl snapshot), and the
     # machine-readable run manifest. None = telemetry off (zero hot-path
@@ -197,6 +219,56 @@ def add_genomics_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--block-variants", type=int, default=DEFAULT_BLOCK_VARIANTS
     )
+    p.add_argument(
+        "--rpc-retries",
+        type=int,
+        default=GenomicsConfig.rpc_retries,
+        help="Total attempts per network request (HTTP/gRPC): transport "
+        "errors and infrastructural statuses (429/502/503/504, "
+        "Retry-After honored) retry with jittered exponential backoff; "
+        "served application errors never do. 1 disables retries",
+    )
+    p.add_argument(
+        "--rpc-retry-deadline",
+        type=float,
+        default=None,
+        help="Wall-clock budget (seconds) per network request that its "
+        "attempts draw down; when it runs dry the last error surfaces "
+        "even if attempts remain",
+    )
+    p.add_argument(
+        "--breaker-threshold",
+        type=int,
+        default=GenomicsConfig.breaker_threshold,
+        help="Per-endpoint circuit breaker: consecutive retryable "
+        "failures before the circuit OPENS and requests shed instantly "
+        "instead of burning their attempt budget against a down tier",
+    )
+    p.add_argument(
+        "--breaker-cooldown",
+        type=float,
+        default=GenomicsConfig.breaker_cooldown,
+        help="Seconds an open circuit sheds before admitting a "
+        "half-open probe; the probe's success closes it, failure "
+        "re-opens and re-arms the cooldown",
+    )
+    p.add_argument(
+        "--grpc-idle-timeout",
+        type=float,
+        default=GenomicsConfig.grpc_idle_timeout,
+        help="Per-read idle deadline (seconds) on gRPC shard streams: "
+        "cancels a stream whose peer is connected but delivering "
+        "nothing (the wedged-peer case keepalive cannot catch); an "
+        "actively-delivering stream never trips it. 0 disables",
+    )
+    p.add_argument(
+        "--fault-plan",
+        default=None,
+        help="Activate the deterministic fault-injection plane: a JSON "
+        "fault plan, inline ('{\"seed\":1,\"rules\":[...]}') or a path "
+        "to a file holding one (env "
+        "SPARK_EXAMPLES_TPU_FAULT_PLAN works too); see docs/RESILIENCE.md",
+    )
 
 
 def add_pca_flags(p: argparse.ArgumentParser) -> None:
@@ -260,6 +332,23 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         "Pod mode arms each synced round; elastic mode arms only the "
         "final partial-G merge, so there the deadline must budget the "
         "whole-run ingest skew between the fastest and slowest host",
+    )
+    p.add_argument(
+        "--shard-retries",
+        type=int,
+        default=PcaConfig.shard_retries,
+        help="Total attempts per ingested shard (fused/checkpointed "
+        "ingest tiers): extraction is idempotent, so a failed shard "
+        "re-executes with backoff instead of killing the run — results "
+        "are identical, only wall-clock changes. 1 = fail fast "
+        "(historical behavior)",
+    )
+    p.add_argument(
+        "--shard-retry-deadline",
+        type=float,
+        default=None,
+        help="Per-shard wall-clock budget (seconds) its retry attempts "
+        "draw down",
     )
     p.add_argument(
         "--trace-dir",

@@ -149,6 +149,12 @@ def save_lane(
         directory, f"{_LANE_PREFIX}{uuid.uuid4().hex}{_LANE_SUFFIX}"
     )
     os.replace(tmp, path)
+    # Chaos seam: a "torn" rule truncates the committed lane (the
+    # non-atomic-filesystem failure load_lanes discards with a warning
+    # and whose units resume re-executes) — see utils/checkpoint.py.
+    from spark_examples_tpu.utils.checkpoint import _apply_write_fault
+
+    _apply_write_fault("checkpoint.lane_write", path)
     return path
 
 
@@ -246,6 +252,13 @@ def merge_and_supersede(
     :func:`load_lanes` to discard.
     """
     path = save_lane(directory, g, units, run_digest)
+    from spark_examples_tpu.resilience import faults
+
+    if faults.take("checkpoint.lane_supersede", key=path) is not None:
+        # Injected crash in the window between write-new and delete-old:
+        # the stale subset lanes stay behind, exactly the residue the
+        # load_lanes subset-discard pass exists to clean up.
+        return path
     for old in supersedes:
         if os.path.abspath(old) == os.path.abspath(path):
             continue

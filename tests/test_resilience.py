@@ -1,0 +1,1509 @@
+"""Unified resilience layer: retry policies, breakers, fault plane, chaos.
+
+Three layers of coverage:
+
+1. Unit: RetryPolicy backoff/budget/Retry-After semantics, the
+   per-transport classification tables, circuit-breaker transitions,
+   FaultPlan determinism and rule matching.
+2. Integration: the HTTP tier retrying 503s and shedding through an
+   open breaker, the gRPC per-read idle timeout and bind-failure check,
+   oauth retry classification, the watchdog exit-77 fail-stop, the
+   light-mirror upgrade TOCTOU re-verify.
+3. Chaos harness (the acceptance bar): the full CPU pipeline runs under
+   seeded fault plans — transport errors, mid-stream worker death, torn
+   checkpoint/lane writes — and the results are NUMERICALLY IDENTICAL
+   to the fault-free run, with the injected faults and breaker
+   transitions visible in trace/metrics artifacts that
+   ``scripts/validate_trace.py`` validates. A randomized soak
+   (``-m slow``; ``scripts/chaos_soak.sh``) fuzzes the same invariant.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu import resilience
+from spark_examples_tpu.genomics.fixtures import (
+    DEFAULT_VARIANT_SET_ID,
+    synthetic_cohort,
+)
+from spark_examples_tpu.genomics.service import (
+    GenomicsServiceServer,
+    HttpVariantSource,
+)
+from spark_examples_tpu.genomics.shards import shards_for_references
+from spark_examples_tpu.genomics.sources import JsonlSource
+from spark_examples_tpu.models.pca import VariantsPcaDriver
+from spark_examples_tpu.obs.session import TelemetrySession
+from spark_examples_tpu.resilience import (
+    Budget,
+    BreakerSet,
+    CircuitBreaker,
+    CircuitOpenError,
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    RetryDecision,
+    RetryPolicy,
+    call_with_retry,
+    classify_grpc,
+    classify_http,
+    classify_ingest,
+    classify_oauth,
+    faults,
+)
+from spark_examples_tpu.utils.config import PcaConfig
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_trace",
+        os.path.join(_REPO_ROOT, "scripts", "validate_trace.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+validate = _load_validator()
+
+REFS = "17:41196311:41277499"
+
+
+# -- unit: retry policy -------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_backoff_grows_exponentially_and_caps(self):
+        p = RetryPolicy(
+            base_delay=0.1, multiplier=2.0, max_delay=0.5, jitter=0.0
+        )
+        delays = [p.backoff_delay(k) for k in (1, 2, 3, 4, 5)]
+        assert delays == pytest.approx([0.1, 0.2, 0.4, 0.5, 0.5])
+
+    def test_jitter_stays_within_fraction(self):
+        import random
+
+        p = RetryPolicy(base_delay=1.0, jitter=0.25, max_delay=10.0)
+        rng = random.Random(7)
+        for _ in range(200):
+            d = p.backoff_delay(1, rng)
+            assert 0.75 <= d <= 1.25
+
+    def test_retries_then_succeeds(self):
+        calls, sleeps = [], []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("flaky")
+            return "ok"
+
+        out = call_with_retry(
+            fn,
+            RetryPolicy(max_attempts=4, jitter=0.0, base_delay=0.01),
+            classify_ingest,
+            sleep=sleeps.append,
+        )
+        assert out == "ok"
+        assert len(calls) == 3
+        assert len(sleeps) == 2
+
+    def test_non_retryable_raises_on_first_attempt(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("data error")
+
+        with pytest.raises(ValueError):
+            call_with_retry(
+                fn,
+                RetryPolicy(max_attempts=5, base_delay=0.0),
+                classify_ingest,
+                sleep=lambda s: None,
+            )
+        assert len(calls) == 1
+
+    def test_attempts_exhausted_raises_last_error(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise OSError(f"fail {len(calls)}")
+
+        with pytest.raises(OSError, match="fail 3"):
+            call_with_retry(
+                fn,
+                RetryPolicy(max_attempts=3, jitter=0.0, base_delay=0.0),
+                classify_ingest,
+                sleep=lambda s: None,
+            )
+        assert len(calls) == 3
+
+    def test_deadline_budget_draws_down(self):
+        """Attempts stop when the wall-clock budget runs dry, even with
+        attempts remaining — the per-shard budget semantics."""
+        now = [0.0]
+        budget = Budget(1.0, clock=lambda: now[0])
+        calls = []
+
+        def fn():
+            calls.append(1)
+            now[0] += 0.6  # each attempt burns 0.6s of the 1s budget
+            raise OSError("slow failure")
+
+        with pytest.raises(OSError):
+            call_with_retry(
+                fn,
+                RetryPolicy(max_attempts=10, jitter=0.0, base_delay=0.0),
+                classify_ingest,
+                budget=budget,
+                sleep=lambda s: None,
+            )
+        assert len(calls) == 2  # third attempt would start past deadline
+
+    def test_retry_after_hint_overrides_backoff(self):
+        sleeps = []
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) == 1:
+                raise OSError("throttled")
+            return "ok"
+
+        def classify(exc):
+            return RetryDecision(True, "throttle", delay_hint=1.23)
+
+        call_with_retry(
+            fn,
+            RetryPolicy(max_attempts=3, jitter=0.0, base_delay=99.0),
+            classify,
+            sleep=sleeps.append,
+        )
+        assert sleeps == [1.23]
+
+    def test_retry_after_hint_is_capped_by_max_delay(self):
+        """A server-directed hour-long Retry-After must not park a
+        worker thread: the policy's own ceiling caps it."""
+        sleeps = []
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) == 1:
+                raise OSError("throttled hard")
+            return "ok"
+
+        call_with_retry(
+            fn,
+            RetryPolicy(max_attempts=3, jitter=0.0, max_delay=2.0),
+            lambda e: RetryDecision(True, "x", delay_hint=3600.0),
+            sleep=sleeps.append,
+        )
+        assert sleeps == [2.0]
+
+    def test_retry_after_ignored_when_policy_says_so(self):
+        sleeps = []
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) == 1:
+                raise OSError("throttled")
+            return "ok"
+
+        call_with_retry(
+            fn,
+            RetryPolicy(
+                max_attempts=3,
+                jitter=0.0,
+                base_delay=0.5,
+                honor_retry_after=False,
+            ),
+            lambda e: RetryDecision(True, "x", delay_hint=9.0),
+            sleep=sleeps.append,
+        )
+        assert sleeps == [0.5]
+
+
+class TestBudget:
+    def test_unbounded_never_exhausts(self):
+        b = Budget(None)
+        assert not b.exhausted()
+        assert b.remaining() == float("inf")
+
+    def test_draws_down_with_clock(self):
+        now = [0.0]
+        b = Budget(2.0, clock=lambda: now[0])
+        assert b.remaining() == pytest.approx(2.0)
+        now[0] = 1.5
+        assert b.remaining() == pytest.approx(0.5)
+        now[0] = 2.5
+        assert b.exhausted()
+
+
+class TestClassifiers:
+    @staticmethod
+    def _served(code, retry_after=None):
+        from spark_examples_tpu.genomics.service import _ServedHttpError
+
+        err = IOError(f"/x: HTTP {code}")
+        err.__cause__ = _ServedHttpError(code, "x", retry_after)
+        return err
+
+    def test_http_transport_error_retries(self):
+        assert classify_http(IOError("connection reset")).retryable
+
+    def test_http_infrastructural_statuses_retry(self):
+        for code in (429, 502, 503, 504):
+            d = classify_http(self._served(code))
+            assert d.retryable, code
+
+    def test_http_retry_after_travels_on_the_decision(self):
+        d = classify_http(self._served(503, retry_after=7.0))
+        assert d.delay_hint == 7.0
+
+    def test_http_application_statuses_do_not_retry(self):
+        # 500 included: the genomics service maps deterministic source
+        # errors to 500, and a bad shard re-requested stays bad.
+        for code in (400, 401, 404, 500):
+            assert not classify_http(self._served(code)).retryable, code
+
+    def test_http_circuit_open_is_not_retryable(self):
+        assert not classify_http(CircuitOpenError("e", 1.0)).retryable
+
+    def test_oauth_5xx_and_transport_retry_4xx_denials_do_not(self):
+        from urllib.error import HTTPError, URLError
+
+        def http_error(code):
+            return HTTPError("http://t", code, "x", {}, None)
+
+        assert classify_oauth(http_error(500)).retryable
+        assert classify_oauth(http_error(503)).retryable
+        assert classify_oauth(http_error(429)).retryable
+        assert not classify_oauth(http_error(400)).retryable
+        assert not classify_oauth(http_error(401)).retryable
+        assert classify_oauth(URLError("refused")).retryable
+        assert classify_oauth(OSError("reset")).retryable
+
+    def test_grpc_codes(self):
+        grpc = pytest.importorskip("grpc")
+
+        class Fake(Exception):
+            def __init__(self, code):
+                self._code = code
+
+            def code(self):
+                return self._code
+
+        assert classify_grpc(Fake(grpc.StatusCode.UNAVAILABLE)).retryable
+        assert classify_grpc(
+            Fake(grpc.StatusCode.DEADLINE_EXCEEDED)
+        ).retryable
+        assert not classify_grpc(
+            Fake(grpc.StatusCode.UNAUTHENTICATED)
+        ).retryable
+        assert not classify_grpc(Fake(grpc.StatusCode.NOT_FOUND)).retryable
+        assert not classify_grpc(
+            Fake(grpc.StatusCode.INVALID_ARGUMENT)
+        ).retryable
+
+    def test_ingest_io_and_wire_corruption_retry(self):
+        assert classify_ingest(IOError("stream aborted")).retryable
+        assert classify_ingest(
+            json.JSONDecodeError("bad", "doc", 0)
+        ).retryable
+        assert not classify_ingest(ValueError("shape")).retryable
+
+
+# -- unit: circuit breaker ----------------------------------------------------
+
+
+class TestCircuitBreaker:
+    @staticmethod
+    def _breaker(**kw):
+        now = [0.0]
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("cooldown_s", 10.0)
+        b = CircuitBreaker("test-endpoint", clock=lambda: now[0], **kw)
+        return b, now
+
+    def test_opens_after_threshold_and_sheds(self):
+        b, _ = self._breaker()
+        for _ in range(3):
+            b.before_call()
+            b.record_failure()
+        assert b.state == "open"
+        with pytest.raises(CircuitOpenError):
+            b.before_call()
+
+    def test_success_resets_failure_count(self):
+        b, _ = self._breaker()
+        for _ in range(2):
+            b.before_call()
+            b.record_failure()
+        b.before_call()
+        b.record_success()
+        for _ in range(2):
+            b.before_call()
+            b.record_failure()
+        assert b.state == "closed"
+
+    def test_half_open_probe_closes_on_success(self):
+        b, now = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        now[0] = 11.0  # past the cooldown: the next call is the probe
+        b.before_call()
+        assert b.state == "half_open"
+        b.record_success()
+        assert b.state == "closed"
+        b.before_call()  # closed again: calls pass freely
+
+    def test_half_open_probe_reopens_on_failure(self):
+        b, now = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        now[0] = 11.0
+        b.before_call()
+        b.record_failure()
+        assert b.state == "open"
+        with pytest.raises(CircuitOpenError):
+            b.before_call()  # cooldown re-armed from t=11
+        now[0] = 22.0
+        b.before_call()  # next probe window
+        assert b.state == "half_open"
+
+    def test_half_open_concurrent_probes_bounded(self):
+        b, now = self._breaker(half_open_probes=1)
+        for _ in range(3):
+            b.record_failure()
+        now[0] = 11.0
+        b.before_call()  # the one admitted probe
+        with pytest.raises(CircuitOpenError):
+            b.before_call()  # a second concurrent probe sheds
+
+    def test_half_open_probe_answered_by_application_error_closes(self):
+        """A non-retryable failure means the endpoint ANSWERED: a
+        half-open probe that gets a served 404 must close the circuit
+        (transport is alive), never leak the probe slot and wedge the
+        breaker half-open forever."""
+        b, now = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        now[0] = 11.0
+
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("served application error")
+
+        with pytest.raises(ValueError):
+            call_with_retry(
+                fn,
+                RetryPolicy(max_attempts=3, base_delay=0.0),
+                classify_ingest,  # ValueError → non-retryable
+                breaker=b,
+                sleep=lambda s: None,
+            )
+        assert len(calls) == 1
+        assert b.state == "closed"
+        b.before_call()  # traffic flows again
+
+    def test_release_probe_returns_the_slot_without_verdict(self):
+        """An abandoned probe (no success/failure recorded) gives its
+        slot back so the next caller can probe."""
+        b, now = self._breaker(half_open_probes=1)
+        for _ in range(3):
+            b.record_failure()
+        now[0] = 11.0
+        b.before_call()  # probe admitted, then abandoned
+        b.release_probe()
+        b.before_call()  # the slot is free again (no shed)
+        assert b.state == "half_open"
+
+    def test_breaker_set_keys_per_endpoint(self):
+        s = BreakerSet("http:", failure_threshold=1, cooldown_s=60.0)
+        s.get("/variants").record_failure()
+        assert s.get("/variants").state == "open"
+        assert s.get("/callsets").state == "closed"
+        assert s.states() == {"/variants": "open", "/callsets": "closed"}
+
+
+# -- unit: fault plane --------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_inject_is_noop_without_plan(self):
+        faults.clear_plan()
+        faults.inject("transport.http.request", key="/variants")  # no raise
+
+    def test_error_rule_fires_once_then_exhausts(self):
+        plan = FaultPlan(
+            rules=[FaultRule(site="a.b", kind="error", times=1)]
+        )
+        with pytest.raises(InjectedFault):
+            plan.inject("a.b")
+        plan.inject("a.b")  # exhausted: no-op
+        assert plan.fired_total == 1
+
+    def test_site_glob_and_key_match(self):
+        plan = FaultPlan(
+            rules=[
+                FaultRule(
+                    site="transport.*",
+                    kind="error",
+                    times=None,
+                    match="shard-7",
+                )
+            ]
+        )
+        plan.inject("transport.http.request", key="shard-3")  # no match
+        with pytest.raises(InjectedFault):
+            plan.inject("transport.grpc.stream", key="shard-7")
+        plan.inject("ingest.shard", key="shard-7")  # site mismatch
+        assert plan.fired_total == 1
+
+    def test_after_skips_early_hits(self):
+        plan = FaultPlan(
+            rules=[FaultRule(site="s", kind="error", times=1, after=2)]
+        )
+        plan.inject("s")
+        plan.inject("s")
+        with pytest.raises(InjectedFault):
+            plan.inject("s")
+
+    def test_probability_draws_are_deterministic_per_seed(self):
+        def pattern(seed):
+            plan = FaultPlan(
+                seed=seed,
+                rules=[
+                    FaultRule(
+                        site="s", kind="error", probability=0.5, times=None
+                    )
+                ],
+            )
+            out = []
+            for _ in range(64):
+                try:
+                    plan.inject("s")
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+            return out
+
+        a, b, c = pattern(1), pattern(1), pattern(2)
+        assert a == b  # same seed, same decisions
+        assert a != c  # a different seed decides differently
+        assert 8 < sum(a) < 56  # p=0.5 actually mixes
+
+    def test_json_spec_roundtrip_and_env_activation(self, tmp_path):
+        spec = {
+            "seed": 3,
+            "rules": [
+                {"site": "ingest.shard", "kind": "stall", "stall_s": 0.01}
+            ],
+        }
+        inline = FaultPlan.from_spec(json.dumps(spec))
+        assert inline.seed == 3 and inline.to_dict()["rules"][0][
+            "site"
+        ] == "ingest.shard"
+        path = tmp_path / "plan.json"
+        path.write_text(json.dumps(spec))
+        from_file = FaultPlan.from_spec(str(path))
+        assert from_file.to_dict()["seed"] == 3
+        env = {resilience.FAULT_PLAN_ENV: json.dumps(spec)}
+        from_env = faults.plan_from_env(env)
+        assert from_env is not None and from_env.seed == 3
+        assert faults.plan_from_env({}) is None
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="kind"):
+            FaultRule(site="s", kind="explode")
+
+    def test_wrap_lines_truncate_corrupt_stall_error(self):
+        lines = [b"l0", b"l1", b"l2"]
+
+        def run(rule):
+            plan = FaultPlan(rules=[rule])
+            return list(
+                faults.wrap_lines("st", iter(lines), plan=plan)
+            )
+
+        assert run(
+            FaultRule(site="st", kind="truncate", at_line=1)
+        ) == [b"l0"]
+        corrupted = run(FaultRule(site="st", kind="corrupt", at_line=1))
+        assert corrupted[0] == b"l0" and corrupted[2] == b"l2"
+        assert corrupted[1] != b"l1" and b"corrupt" in corrupted[1]
+        assert run(
+            FaultRule(site="st", kind="stall", at_line=0, stall_s=0.0)
+        ) == lines
+        with pytest.raises(InjectedFault):
+            run(FaultRule(site="st", kind="error", at_line=2))
+
+    def test_active_plan_scopes_and_restores(self):
+        plan = FaultPlan(rules=[FaultRule(site="s", kind="error")])
+        assert faults.current_plan() is None
+        with faults.active_plan(plan):
+            assert faults.current_plan() is plan
+            with pytest.raises(InjectedFault):
+                faults.inject("s")
+        assert faults.current_plan() is None
+
+
+# -- integration: HTTP tier ---------------------------------------------------
+
+
+class _ScriptedHttpServer:
+    """Serves /callsets: the first ``fail_first`` requests get ``code``
+    (with optional Retry-After), the rest succeed with an empty list."""
+
+    def __init__(self, fail_first=2, code=503, retry_after="0"):
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                srv.requests.append(self.path)
+                if len(srv.requests) <= srv.fail_first:
+                    body = b"try later"
+                    self.send_response(srv.code)
+                    if srv.retry_after is not None:
+                        self.send_header("Retry-After", srv.retry_after)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                body = b"[]\n"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.fail_first = fail_first
+        self.code = code
+        self.retry_after = retry_after
+        self.requests = []
+        self._server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._server.server_port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class TestHttpRetryIntegration:
+    def test_503_with_retry_after_is_retried_to_success(self):
+        srv = _ScriptedHttpServer(fail_first=2, code=503, retry_after="0")
+        try:
+            http = HttpVariantSource(
+                srv.url,
+                retry_policy=RetryPolicy(
+                    max_attempts=4, base_delay=0.01, jitter=0.0
+                ),
+            )
+            assert http.list_callsets("") == []
+            assert len(srv.requests) == 3
+            # A retried-to-success request is NOT an unsuccessful
+            # response — the accumulator counts outcomes, not attempts.
+            assert http.stats.unsuccessful_responses == 0
+            assert http.stats.io_exceptions == 0
+        finally:
+            srv.stop()
+
+    def test_exhausted_retries_surface_the_served_status(self):
+        srv = _ScriptedHttpServer(fail_first=99, code=503)
+        try:
+            http = HttpVariantSource(
+                srv.url,
+                retry_policy=RetryPolicy(
+                    max_attempts=3, base_delay=0.01, jitter=0.0
+                ),
+            )
+            with pytest.raises(IOError, match="503"):
+                http.list_callsets("")
+            assert len(srv.requests) == 3
+            assert http.stats.unsuccessful_responses == 1
+        finally:
+            srv.stop()
+
+    def test_404_is_an_answer_not_a_retry(self):
+        srv = _ScriptedHttpServer(fail_first=99, code=404)
+        try:
+            http = HttpVariantSource(srv.url)
+            with pytest.raises(IOError, match="404"):
+                http.list_callsets("")
+            assert len(srv.requests) == 1
+        finally:
+            srv.stop()
+
+    def test_breaker_opens_and_sheds_against_dead_endpoint(self):
+        http = HttpVariantSource(
+            "http://127.0.0.1:1",  # nothing listens here
+            timeout=2,
+            retry_policy=RetryPolicy(
+                max_attempts=2, base_delay=0.01, jitter=0.0
+            ),
+            breakers=BreakerSet(
+                "t:", failure_threshold=2, cooldown_s=60.0
+            ),
+        )
+        with pytest.raises(IOError):
+            http.list_callsets("")  # 2 attempts = 2 failures → open
+        with pytest.raises(CircuitOpenError):
+            http.list_callsets("")  # shed instantly, no socket touched
+        assert http.stats.io_exceptions == 2
+
+
+# -- integration: gRPC tier ---------------------------------------------------
+
+
+grpc_missing = False
+try:
+    import grpc  # noqa: F401
+except ImportError:  # pragma: no cover - grpcio is in the test image
+    grpc_missing = True
+
+
+@pytest.mark.skipif(grpc_missing, reason="grpcio not installed")
+class TestGrpcResilience:
+    def test_idle_timeout_cancels_wedged_stream(self):
+        from spark_examples_tpu.genomics.grpc_transport import (
+            GrpcGenomicsServer,
+            GrpcVariantSource,
+        )
+
+        inner = synthetic_cohort(4, 10, seed=1)
+        release = threading.Event()
+
+        class WedgesMidStream:
+            def list_callsets(self, vsid):
+                return inner.list_callsets(vsid)
+
+            def stream_variants(self, vsid, shard):
+                it = inner.stream_variants(vsid, shard)
+                yield next(it)
+                # Connected but delivering nothing: keepalive stays
+                # happy, only the per-read idle deadline can see this.
+                release.wait(30)
+
+            def stream_reads(self, rgsid, shard):
+                return inner.stream_reads(rgsid, shard)
+
+        server = GrpcGenomicsServer(WedgesMidStream()).start()
+        client = GrpcVariantSource(
+            f"grpc://127.0.0.1:{server.port}", idle_timeout=0.5
+        )
+        shard = shards_for_references(REFS, 100_000)[0]
+        try:
+            with pytest.raises(IOError, match="wedged"):
+                list(client.stream_variants("", shard))
+            assert client.stats.io_exceptions == 1
+        finally:
+            release.set()
+            client.close()
+            server.stop()
+
+    def test_actively_delivering_stream_never_trips_idle(self):
+        from spark_examples_tpu.genomics.grpc_transport import (
+            GrpcGenomicsServer,
+            GrpcVariantSource,
+        )
+
+        inner = synthetic_cohort(4, 20, seed=1)
+
+        class SlowButFlowing:
+            def list_callsets(self, vsid):
+                return inner.list_callsets(vsid)
+
+            def stream_variants(self, vsid, shard):
+                import time
+
+                for v in inner.stream_variants(vsid, shard):
+                    time.sleep(0.05)  # slower than the idle budget? no:
+                    yield v  # each message resets the idle clock
+
+            def stream_reads(self, rgsid, shard):
+                return inner.stream_reads(rgsid, shard)
+
+        server = GrpcGenomicsServer(SlowButFlowing()).start()
+        client = GrpcVariantSource(
+            f"grpc://127.0.0.1:{server.port}", idle_timeout=0.5
+        )
+        shard = shards_for_references(REFS, 100_000)[0]
+        try:
+            got = list(client.stream_variants("", shard))
+            assert len(got) == 20
+            assert client.stats.io_exceptions == 0
+        finally:
+            client.close()
+            server.stop()
+
+    def test_injected_truncation_is_loud_not_silent(self):
+        """gRPC has no end sentinel, so a truncate rule must surface as
+        an error — a silent early end would drop records undetectably,
+        which no REAL gRPC failure can do (truncation is a status)."""
+        from spark_examples_tpu.genomics.grpc_transport import (
+            GrpcGenomicsServer,
+            GrpcVariantSource,
+        )
+
+        inner = synthetic_cohort(4, 10, seed=1)
+        server = GrpcGenomicsServer(inner).start()
+        client = GrpcVariantSource(f"grpc://127.0.0.1:{server.port}")
+        shard = shards_for_references(REFS, 100_000)[0]
+        plan = FaultPlan(
+            rules=[
+                FaultRule(
+                    site="transport.grpc.stream",
+                    kind="truncate",
+                    times=1,
+                    at_line=2,
+                )
+            ]
+        )
+        try:
+            with faults.active_plan(plan):
+                with pytest.raises(IOError, match="truncate"):
+                    list(client.stream_variants("", shard))
+            assert client.stats.io_exceptions == 1
+            # Fault cleared: the idempotent re-request serves all 10.
+            assert len(list(client.stream_variants("", shard))) == 10
+        finally:
+            client.close()
+            server.stop()
+
+    def test_stream_start_retry_respects_deadline_budget(self):
+        """--rpc-retry-deadline bounds the stream path exactly like the
+        unary path: a zero budget means no retries despite attempts
+        remaining."""
+        from spark_examples_tpu.genomics.grpc_transport import (
+            GrpcVariantSource,
+        )
+
+        client = GrpcVariantSource(
+            "grpc://127.0.0.1:1",
+            timeout=2,
+            retry_policy=RetryPolicy(
+                max_attempts=5,
+                base_delay=0.01,
+                jitter=0.0,
+                deadline=0.0,
+            ),
+        )
+        try:
+            with TelemetrySession() as session:
+                with pytest.raises(IOError):
+                    list(client.stream_variants("", shards_for_references(REFS, 100_000)[0]))
+                counters = session.registry.snapshot()["counters"]
+            retried = [
+                v
+                for k, v in counters.items()
+                if k.startswith("genomics_rpc_retries_total")
+            ]
+            assert sum(retried) == 0  # budget dry → last error surfaced
+        finally:
+            client.close()
+
+    def test_bind_failure_raises_instead_of_port_zero(self):
+        import socket
+
+        from spark_examples_tpu.genomics.grpc_transport import (
+            GrpcGenomicsServer,
+        )
+
+        sock = socket.socket()
+        try:
+            sock.bind(("127.0.0.1", 0))
+            port = sock.getsockname()[1]
+            with pytest.raises(IOError, match="bind"):
+                GrpcGenomicsServer(synthetic_cohort(2, 4), port=port)
+        finally:
+            sock.close()
+
+    def test_unary_retries_are_observable(self):
+        from spark_examples_tpu.genomics.grpc_transport import (
+            GrpcVariantSource,
+        )
+
+        client = GrpcVariantSource(
+            "grpc://127.0.0.1:1",
+            timeout=2,
+            retry_policy=RetryPolicy(
+                max_attempts=3, base_delay=0.01, jitter=0.0
+            ),
+        )
+        try:
+            with TelemetrySession() as session:
+                with pytest.raises(IOError):
+                    client.list_callsets("")
+                counters = session.registry.snapshot()["counters"]
+            retried = [
+                v
+                for k, v in counters.items()
+                if k.startswith("genomics_rpc_retries_total")
+                and 'transport="grpc"' in k
+            ]
+            assert sum(retried) == 2  # 3 attempts = 2 retries
+            assert client.stats.io_exceptions == 1  # counted once
+        finally:
+            client.close()
+
+
+# -- integration: oauth classification ---------------------------------------
+
+
+class _FlakyTokenEndpoint:
+    """Token endpoint that fails the first ``fail_first`` requests with
+    ``code`` and then mints a token; mode 'denial' always answers the
+    RFC 6749 invalid_grant shape."""
+
+    def __init__(self, fail_first=1, code=500, mode="flaky"):
+        ep = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                ep.requests.append(self.path)
+                if ep.mode == "denial":
+                    body = json.dumps(
+                        {
+                            "error": "invalid_grant",
+                            "error_description": "token revoked",
+                        }
+                    ).encode()
+                    self.send_response(400)
+                elif len(ep.requests) <= ep.fail_first:
+                    body = b"upstream blew up"
+                    self.send_response(ep.code)
+                else:
+                    body = json.dumps(
+                        {"access_token": "minted", "token_type": "Bearer"}
+                    ).encode()
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.fail_first = fail_first
+        self.code = code
+        self.mode = mode
+        self.requests = []
+        self._server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.uri = f"http://127.0.0.1:{self._server.server_port}/token"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class TestOauthRetryClassification:
+    def test_transient_5xx_retries_to_a_token(self):
+        from spark_examples_tpu.genomics.oauth import exchange_refresh_token
+
+        ep = _FlakyTokenEndpoint(fail_first=1, code=500)
+        try:
+            token = exchange_refresh_token(
+                "cid",
+                "csec",
+                "rtok",
+                token_uri=ep.uri,
+                retry_policy=RetryPolicy(
+                    max_attempts=3, base_delay=0.01, jitter=0.0
+                ),
+            )
+            assert token == "minted"
+            assert len(ep.requests) == 2
+        finally:
+            ep.stop()
+
+    def test_denial_4xx_surfaces_immediately_without_retry(self):
+        from spark_examples_tpu.genomics.auth import AuthError
+        from spark_examples_tpu.genomics.oauth import exchange_refresh_token
+
+        ep = _FlakyTokenEndpoint(mode="denial")
+        try:
+            with pytest.raises(AuthError, match="invalid_grant"):
+                exchange_refresh_token(
+                    "cid",
+                    "csec",
+                    "rtok",
+                    token_uri=ep.uri,
+                    retry_policy=RetryPolicy(
+                        max_attempts=5, base_delay=0.01
+                    ),
+                )
+            assert len(ep.requests) == 1  # a revoked token never un-revokes
+        finally:
+            ep.stop()
+
+    def test_unreachable_endpoint_exhausts_and_wraps_as_autherror(self):
+        from spark_examples_tpu.genomics.auth import AuthError
+        from spark_examples_tpu.genomics.oauth import exchange_refresh_token
+
+        with pytest.raises(AuthError, match="cannot reach"):
+            exchange_refresh_token(
+                "cid",
+                "csec",
+                "rtok",
+                token_uri="http://127.0.0.1:1/token",
+                retry_policy=RetryPolicy(
+                    max_attempts=2, base_delay=0.01, jitter=0.0
+                ),
+            )
+
+
+# -- integration: watchdog fail-stop ------------------------------------------
+
+
+class TestWatchdogFailStop:
+    def test_armed_phase_overrun_exits_77_with_flushed_telemetry(
+        self, tmp_path
+    ):
+        """The exit-77 path end to end: a stalled 'collective' is shot
+        by the watchdog, the process dies with the distinctive code, the
+        diagnostic names the phase, and the telemetry flush leaves a
+        valid trace carrying the watchdog instant."""
+        trace = tmp_path / "wd.trace.json"
+        script = f"""
+import time
+from spark_examples_tpu.obs.session import TelemetrySession
+from spark_examples_tpu.utils.watchdog import CollectiveWatchdog
+
+with TelemetrySession(trace_out={str(trace)!r}):
+    wd = CollectiveWatchdog(0.3)
+    with wd.armed("chaos test phase"):
+        time.sleep(30)
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 77
+        assert "chaos test phase" in proc.stderr
+        assert "FATAL" in proc.stderr
+        assert validate.validate_trace(str(trace)) == []
+        events = json.loads(trace.read_text())["traceEvents"]
+        assert any(
+            e["name"] == "collective_watchdog_fired" for e in events
+        )
+
+    def test_disarmed_watchdog_never_fires(self):
+        from spark_examples_tpu.utils.watchdog import CollectiveWatchdog
+
+        wd = CollectiveWatchdog(None)
+        with wd.armed("anything"):
+            pass  # no timer, no exit
+
+
+# -- integration: fixture fault plane + mirror TOCTOU -------------------------
+
+
+class TestFixtureFaultPlane:
+    def test_fail_once_surface_preserved_on_the_plan(self):
+        src = synthetic_cohort(4, 10, seed=1)
+        shard = shards_for_references(REFS, 100_000)[0]
+        src._fail_once.add(shard)
+        with pytest.raises(IOError, match="injected stream failure"):
+            list(src.stream_variants("", shard))
+        assert src.stats.io_exceptions == 1
+        assert len(list(src.stream_variants("", shard))) == 10
+        assert src.faults.fired_total == 1
+
+    def test_fail_shards_constructor_arg(self):
+        from spark_examples_tpu.genomics.sources import FixtureSource
+
+        shard = shards_for_references(REFS, 100_000)[0]
+        src = FixtureSource(variants=[], fail_shards=[shard])
+        with pytest.raises(IOError):
+            list(src.stream_variants("", shard))
+        assert list(src.stream_variants("", shard)) == []
+
+
+class TestVsidLineGuard:
+    def test_nested_variant_set_id_key_falls_back_to_parse(self):
+        from spark_examples_tpu.genomics.sources import _line_vsid_matches
+
+        # The only "variant_set_id" sits INSIDE calls — the top-level
+        # record has none, so the zero-parse path must treat it as a
+        # wildcard (match), exactly like the parsed path.
+        line = (
+            b'{"reference_name": "17", "start": 5, '
+            b'"calls": [{"variant_set_id": "other"}]}'
+        )
+        assert _line_vsid_matches(line, "vs-1")
+        # Top-level id still filters exactly.
+        top = (
+            b'{"reference_name": "17", "variant_set_id": "vs-2", '
+            b'"start": 5, "calls": []}'
+        )
+        assert not _line_vsid_matches(top, "vs-1")
+        assert _line_vsid_matches(top, "vs-2")
+
+    def test_matches_parsed_path_on_jsonl_source(self, tmp_path):
+        root = tmp_path / "c"
+        os.makedirs(root)
+        recs = [
+            # Nested decoy only — top level has no variant_set_id.
+            {
+                "reference_name": "17",
+                "start": 41200001,
+                "end": 41200002,
+                "calls": [],
+                "info": {"variant_set_id": ["decoy"]},
+            },
+            {
+                "reference_name": "17",
+                "start": 41200005,
+                "end": 41200006,
+                "variant_set_id": "vs-1",
+                "calls": [],
+            },
+        ]
+        with open(root / "variants.jsonl", "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        with open(root / "callsets.json", "w") as f:
+            f.write("[]")
+        src = JsonlSource(str(root))
+        from spark_examples_tpu.genomics.shards import Shard
+
+        shard = Shard("17", 41200000, 41210000)
+        raw = list(src.stream_variant_lines("vs-1", shard))
+        parsed = list(src.stream_variants("vs-1", shard))
+        # Both paths serve both records: the decoy's nested key is not
+        # a top-level filter, and absent top-level id = wildcard.
+        assert len(raw) == len(parsed) == 2
+
+
+class TestLightMirrorUpgradeReverify:
+    def test_mid_upgrade_cohort_swap_discards_and_raises(self, tmp_path):
+        src = synthetic_cohort(8, 60, seed=9)
+        root = str(tmp_path / "srv")
+        src.dump(root)
+        url_cache = str(tmp_path / "cache")
+        backing = JsonlSource(root)
+        server = GenomicsServiceServer(backing).start()
+        shard = shards_for_references(REFS, 20_000)[0]
+        try:
+            light = HttpVariantSource(
+                f"http://127.0.0.1:{server.port}",
+                cache_dir=url_cache,
+                mirror_mode="light",
+            )
+            indexes = {
+                c.id: i
+                for i, c in enumerate(
+                    light.list_callsets(DEFAULT_VARIANT_SET_ID)
+                )
+            }
+            list(
+                light.stream_carrying(
+                    DEFAULT_VARIANT_SET_ID, shard, indexes, None
+                )
+            )
+        finally:
+            server.stop()
+        mirror_root = os.path.join(
+            url_cache,
+            [d for d in os.listdir(url_cache) if d.startswith("cohort-")][
+                0
+            ],
+        )
+        old_ident = backing.cohort_identity()
+
+        class SwapsMidUpgrade:
+            """Identity answers the OLD cohort until the upgrade files
+            land, then the NEW one — the TOCTOU window."""
+
+            def __init__(self):
+                self.identity_calls = 0
+
+            def cohort_identity(self):
+                self.identity_calls += 1
+                return (
+                    old_ident if self.identity_calls == 1 else "swapped"
+                )
+
+            def __getattr__(self, name):
+                return getattr(backing, name)
+
+        server2 = GenomicsServiceServer(SwapsMidUpgrade()).start()
+        try:
+            full = HttpVariantSource(
+                f"http://127.0.0.1:{server2.port}",
+                cache_dir=url_cache,
+                mirror_mode="full",
+            )
+            with pytest.raises(IOError, match="upgrading"):
+                list(
+                    full.stream_variants(DEFAULT_VARIANT_SET_ID, shard)
+                )
+        finally:
+            server2.stop()
+        # The upgraded files were discarded: the mirror is back to its
+        # light state (sidecar intact), not a mixed-cohort husk.
+        assert not os.path.exists(
+            os.path.join(mirror_root, "variants.jsonl")
+        )
+        assert os.path.exists(os.path.join(mirror_root, ".complete"))
+
+
+# -- the chaos harness --------------------------------------------------------
+
+
+def _chaos_conf(shard_retries=4, **kw):
+    kw.setdefault("variant_set_ids", [DEFAULT_VARIANT_SET_ID])
+    kw.setdefault("references", REFS)
+    kw.setdefault("bases_per_partition", 20_000)
+    kw.setdefault("block_variants", 16)
+    kw.setdefault("ingest_workers", 2)
+    return PcaConfig(shard_retries=shard_retries, **kw)
+
+
+def _coords(result):
+    return np.array([[pc1, pc2] for _, pc1, pc2 in result])
+
+
+@pytest.fixture(scope="module")
+def chaos_cohort(tmp_path_factory):
+    """One dumped cohort + its fault-free pipeline result, shared by
+    every chaos scenario (the baseline all runs must match exactly)."""
+    root = str(tmp_path_factory.mktemp("cohort") / "c")
+    synthetic_cohort(10, 80, seed=3).dump(root)
+    baseline = VariantsPcaDriver(
+        _chaos_conf(shard_retries=1), JsonlSource(root)
+    ).run()
+    return root, baseline
+
+
+class TestChaosHarness:
+    """Acceptance: the full CPU pipeline under seeded fault plans is
+    numerically identical to the fault-free run, and the artifacts show
+    the injected faults and breaker transitions."""
+
+    def test_transport_fault_plan_is_result_identical(
+        self, chaos_cohort, tmp_path
+    ):
+        root, baseline = chaos_cohort
+        server = GenomicsServiceServer(JsonlSource(root)).start()
+        plan = FaultPlan(
+            seed=11,
+            rules=[
+                FaultRule(
+                    site="transport.http.request", kind="error", times=2
+                ),
+                FaultRule(
+                    site="transport.http.stream",
+                    kind="truncate",
+                    times=1,
+                    at_line=1,
+                ),
+                FaultRule(
+                    site="transport.http.stream",
+                    kind="corrupt",
+                    times=1,
+                    at_line=0,
+                ),
+                FaultRule(
+                    site="transport.http.stream",
+                    kind="stall",
+                    times=1,
+                    stall_s=0.01,
+                ),
+            ],
+        )
+        trace = str(tmp_path / "chaos.trace.json")
+        metrics = str(tmp_path / "chaos.prom")
+        manifest = str(tmp_path / "chaos.manifest.json")
+        try:
+            with TelemetrySession(
+                trace_out=trace, metrics_out=metrics, manifest_out=manifest
+            ):
+                with faults.active_plan(plan):
+                    http = HttpVariantSource(
+                        f"http://127.0.0.1:{server.port}",
+                        retry_policy=RetryPolicy(
+                            max_attempts=4, base_delay=0.01, jitter=0.0
+                        ),
+                    )
+                    result = VariantsPcaDriver(
+                        _chaos_conf(shard_retries=4), http
+                    ).run()
+                # Same artifacts also record breaker behavior: a dead
+                # endpoint trips its breaker open, then sheds.
+                dead = HttpVariantSource(
+                    "http://127.0.0.1:1",
+                    timeout=2,
+                    retry_policy=RetryPolicy(
+                        max_attempts=2, base_delay=0.01, jitter=0.0
+                    ),
+                    breakers=BreakerSet(
+                        "chaos:", failure_threshold=2, cooldown_s=60.0
+                    ),
+                )
+                with pytest.raises(IOError):
+                    dead.list_callsets("")
+                with pytest.raises(CircuitOpenError):
+                    dead.list_callsets("")
+        finally:
+            server.stop()
+        # Numerically identical: same shard requests after retries, same
+        # accumulation order, same eigensolver input → same bytes out.
+        assert [r[0] for r in result] == [r[0] for r in baseline]
+        np.testing.assert_array_equal(
+            _coords(result), _coords(baseline)
+        )
+        # Every fault fired, and the run still converged.
+        assert plan.fired_total == 5
+        # Artifacts are schema-valid and carry the failure story.
+        assert validate.validate_trace(trace) == []
+        assert validate.validate_metrics(metrics) == []
+        assert validate.validate_manifest(manifest) == []
+        events = json.loads(open(trace).read())["traceEvents"]
+        names = {e["name"] for e in events}
+        assert "fault_injected" in names
+        assert "retry_backoff" in names
+        assert "breaker_transition" in names
+        prom = open(metrics).read()
+        assert "resilience_faults_injected_total" in prom
+        assert "resilience_breaker_transitions_total" in prom
+        assert "genomics_rpc_retries_total" in prom
+
+    def test_worker_death_and_slow_lanes_result_identical(
+        self, chaos_cohort
+    ):
+        root, baseline = chaos_cohort
+        plan = FaultPlan(
+            seed=23,
+            rules=[
+                FaultRule(site="ingest.shard", kind="error", times=2),
+                FaultRule(
+                    site="ingest.shard",
+                    kind="stall",
+                    times=2,
+                    stall_s=0.01,
+                ),
+            ],
+        )
+        with faults.active_plan(plan):
+            result = VariantsPcaDriver(
+                _chaos_conf(shard_retries=4), JsonlSource(root)
+            ).run()
+        assert plan.fired_total == 4
+        np.testing.assert_array_equal(_coords(result), _coords(baseline))
+
+    def test_torn_checkpoint_writes_and_resume_identical(
+        self, chaos_cohort, tmp_path
+    ):
+        root, baseline = chaos_cohort
+        ckdir = str(tmp_path / "ck")
+        plan = FaultPlan(
+            seed=31,
+            rules=[
+                FaultRule(
+                    site="checkpoint.snapshot_write",
+                    kind="torn",
+                    times=None,
+                )
+            ],
+        )
+        conf = _chaos_conf(
+            shard_retries=1, checkpoint_dir=ckdir, checkpoint_every=2
+        )
+        with faults.active_plan(plan):
+            result = VariantsPcaDriver(conf, JsonlSource(root)).run()
+        # Every snapshot written this run was torn — the in-memory
+        # accumulator is unaffected, results identical.
+        assert plan.fired_total >= 1
+        np.testing.assert_array_equal(_coords(result), _coords(baseline))
+        # Resume over the torn snapshot: the tolerant loader discards it
+        # with a warning and re-ingests — identical again, not a crash.
+        resumed = VariantsPcaDriver(conf, JsonlSource(root)).run()
+        np.testing.assert_array_equal(
+            _coords(resumed), _coords(baseline)
+        )
+
+    def test_torn_lane_writes_and_elastic_resume_identical(
+        self, chaos_cohort, tmp_path
+    ):
+        root, baseline = chaos_cohort
+        ckdir = str(tmp_path / "elastic-ck")
+        conf = _chaos_conf(
+            shard_retries=1,
+            checkpoint_dir=ckdir,
+            checkpoint_every=2,
+            elastic_checkpoint=True,
+        )
+        plan = FaultPlan(
+            seed=47,
+            rules=[
+                FaultRule(
+                    site="checkpoint.lane_write", kind="torn", times=1
+                ),
+                FaultRule(
+                    site="checkpoint.lane_supersede",
+                    kind="error",
+                    times=1,
+                ),
+            ],
+        )
+        with faults.active_plan(plan):
+            result = VariantsPcaDriver(conf, JsonlSource(root)).run()
+        assert plan.fired_total == 2
+        np.testing.assert_array_equal(_coords(result), _coords(baseline))
+        # Resume: unreadable/stale lanes are discarded (their units
+        # re-executed), the run converges to the same coordinates.
+        resumed = VariantsPcaDriver(conf, JsonlSource(root)).run()
+        np.testing.assert_array_equal(
+            _coords(resumed), _coords(baseline)
+        )
+
+    def test_crash_after_torn_snapshot_then_resume(self, chaos_cohort, tmp_path):
+        """Composed failure: a torn snapshot AND a mid-run worker death
+        (no shard retries) — the run dies, resume discards the torn file
+        and completes identically."""
+        root, baseline = chaos_cohort
+        ckdir = str(tmp_path / "ck2")
+        conf = _chaos_conf(
+            shard_retries=1, checkpoint_dir=ckdir, checkpoint_every=2
+        )
+        plan = FaultPlan(
+            rules=[
+                FaultRule(
+                    site="checkpoint.snapshot_write", kind="torn", times=1
+                ),
+                FaultRule(
+                    site="ingest.shard", kind="error", times=1, after=2
+                ),
+            ]
+        )
+        with faults.active_plan(plan):
+            with pytest.raises(IOError):
+                VariantsPcaDriver(conf, JsonlSource(root)).run()
+        resumed = VariantsPcaDriver(conf, JsonlSource(root)).run()
+        np.testing.assert_array_equal(
+            _coords(resumed), _coords(baseline)
+        )
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    """Randomized soak: seeded random fault plans over the full served
+    pipeline; every one must converge to the fault-free coordinates.
+    ``CHAOS_SOAK_ITERS`` scales the fuzz (scripts/chaos_soak.sh)."""
+
+    def test_randomized_fault_plans_converge(self, tmp_path):
+        import random
+
+        iters = int(os.environ.get("CHAOS_SOAK_ITERS", "3"))
+        root = str(tmp_path / "c")
+        synthetic_cohort(10, 80, seed=3).dump(root)
+        baseline = VariantsPcaDriver(
+            _chaos_conf(shard_retries=1), JsonlSource(root)
+        ).run()
+        for seed in range(iters):
+            rng = random.Random(seed)
+            rules = [
+                FaultRule(
+                    site="transport.http.request",
+                    kind="error",
+                    probability=0.2,
+                    times=4,
+                ),
+                FaultRule(
+                    site="transport.http.stream",
+                    kind=rng.choice(["truncate", "corrupt", "error"]),
+                    probability=0.25,
+                    times=3,
+                    at_line=rng.randint(0, 2),
+                ),
+                FaultRule(
+                    site="ingest.shard",
+                    kind="error",
+                    probability=0.2,
+                    times=3,
+                ),
+                FaultRule(
+                    site="ingest.shard",
+                    kind="stall",
+                    probability=0.3,
+                    times=3,
+                    stall_s=0.01,
+                ),
+                FaultRule(
+                    site="checkpoint.snapshot_write",
+                    kind="torn",
+                    probability=0.5,
+                    times=None,
+                ),
+            ]
+            plan = FaultPlan(seed=seed, rules=rules)
+            ckdir = str(tmp_path / f"ck-{seed}")
+            server = GenomicsServiceServer(JsonlSource(root)).start()
+            try:
+                with faults.active_plan(plan):
+                    http = HttpVariantSource(
+                        f"http://127.0.0.1:{server.port}",
+                        retry_policy=RetryPolicy(
+                            max_attempts=6, base_delay=0.01, jitter=0.1
+                        ),
+                    )
+                    result = VariantsPcaDriver(
+                        _chaos_conf(
+                            shard_retries=6,
+                            checkpoint_dir=ckdir,
+                            checkpoint_every=2,
+                        ),
+                        http,
+                    ).run()
+            finally:
+                server.stop()
+            np.testing.assert_array_equal(
+                _coords(result), _coords(baseline)
+            )
+            # And the resume over whatever the plan left behind:
+            resumed = VariantsPcaDriver(
+                _chaos_conf(
+                    shard_retries=1,
+                    checkpoint_dir=ckdir,
+                    checkpoint_every=2,
+                ),
+                JsonlSource(root),
+            ).run()
+            np.testing.assert_array_equal(
+                _coords(resumed), _coords(baseline)
+            )
