@@ -65,8 +65,13 @@ echo "dtype probe rc=$?" >&2
 
 # 4. Warm local all-autosomes CLI (fused default) when the cohort is on
 #    disk — the BASELINE-4 record run.
+#    Driver runs use the soft-cancel wrapper, NEVER raw `timeout`: a
+#    signal landing mid-dispatch is what wedged the relay in round 5
+#    (docs/OPERATIONS.md §6b) — the driver exits cleanly (code 75) at a
+#    block boundary instead.
 if [ -d /tmp/baseline4_cohort ]; then
-  timeout 1800 python -m spark_examples_tpu.cli.main pca \
+  bash scripts/tpu_run.sh -d 1800 -g 120 -- \
+    python -m spark_examples_tpu.cli.main pca \
     --input-path /tmp/baseline4_cohort --all-references \
     --output-path "$OUT/b4_local" >"$OUT/b4_local_fused.txt" 2>&1
   echo "local all-autosomes fused rc=$?" >&2
@@ -85,17 +90,22 @@ try:
 except OSError:
     sys.exit(1)
 PY
-  timeout 1800 env GENOMICS_APPLICATION_CREDENTIALS=/tmp/creds.json \
+  bash scripts/tpu_run.sh -d 1800 -g 120 -- \
+    env GENOMICS_APPLICATION_CREDENTIALS=/tmp/creds.json \
     python -m spark_examples_tpu.cli.main pca \
     --api-url http://127.0.0.1:18719 --all-references \
     --cache-dir /tmp/b4cache --mirror-mode light \
     --output-path "$OUT/b4_remote_light" \
     >"$OUT/b4_remote_light.txt" 2>&1
   echo "remote light-mirror rc=$?" >&2
-  timeout 3600 env GENOMICS_APPLICATION_CREDENTIALS=/tmp/creds.json \
+  # Direct (no cache) streaming — now the binary frame tier
+  # (docs/WIRE_FORMAT.md): the row to re-measure against the round-5
+  # >70-min JSON-parse-bound record.
+  bash scripts/tpu_run.sh -d 3600 -g 120 -- \
+    env GENOMICS_APPLICATION_CREDENTIALS=/tmp/creds.json \
     python -m spark_examples_tpu.cli.main pca \
     --api-url http://127.0.0.1:18719 --all-references \
-    --ingest-workers 8 \
+    --ingest-workers 8 --ingest-order completion \
     --output-path "$OUT/b4_remote_direct" \
     >"$OUT/b4_remote_direct.txt" 2>&1
   echo "remote direct rc=$?" >&2

@@ -104,6 +104,35 @@ def validate_trace(path: str) -> List[str]:
     return errors
 
 
+# Binary wire tier (genomics/wire.py) metric contract: counters carry a
+# transport label ("http"/"grpc"); the decode histogram exposes the full
+# Prometheus triplet. Checked only when present — artifacts from runs
+# that never touched the frame tier stay valid.
+_WIRE_COUNTERS = ("wire_frames_total", "wire_frame_bytes_total")
+_WIRE_HISTOGRAM = "wire_frame_decode_seconds"
+
+
+def _check_wire_metrics(path: str, sample_lines: List[str]) -> List[str]:
+    errors: List[str] = []
+    names = set()
+    for line in sample_lines:
+        name = re.match(r"[a-zA-Z_:][a-zA-Z0-9_:]*", line).group(0)
+        names.add(name)
+        if name in _WIRE_COUNTERS and 'transport="' not in line:
+            errors.append(
+                f"{path}: {name} sample missing its transport label: "
+                f"{line!r}"
+            )
+    if f"{_WIRE_HISTOGRAM}_bucket" in names:
+        for suffix in ("_sum", "_count"):
+            if f"{_WIRE_HISTOGRAM}{suffix}" not in names:
+                errors.append(
+                    f"{path}: {_WIRE_HISTOGRAM} histogram exposes "
+                    f"buckets but no {suffix} series"
+                )
+    return errors
+
+
 def validate_metrics(path: str) -> List[str]:
     """Errors for a Prometheus text exposition file ([] = valid)."""
     errors: List[str] = []
@@ -116,6 +145,7 @@ def validate_metrics(path: str) -> List[str]:
     if not lines:
         return [f"{path}: empty exposition"]
     samples = 0
+    sample_lines: List[str] = []
     for lineno, line in enumerate(lines, 1):
         if line.startswith("#"):
             if not _PROM_COMMENT.match(line):
@@ -129,8 +159,10 @@ def validate_metrics(path: str) -> List[str]:
             )
             continue
         samples += 1
+        sample_lines.append(line)
     if samples == 0:
         errors.append(f"{path}: no metric samples")
+    errors.extend(_check_wire_metrics(path, sample_lines))
     return errors
 
 

@@ -79,16 +79,10 @@ def _network_source(args):
 
     if args.api_url.startswith("grpc://"):
         # The HTTP/2 server-streaming transport (the reference's bulk
-        # channel technology, VariantsRDD.scala:26,210-211).
-        if getattr(args, "cache_dir", None):
-            # Refuse rather than silently re-stream a 57.7 GB cohort
-            # every run: the mirror/warm tier lives on the HTTP service.
-            raise SystemExit(
-                "--cache-dir/--mirror-mode are HTTP-service features "
-                "(the mirror endpoints live there); use an http:// "
-                "--api-url for cached runs, or drop --cache-dir for "
-                "direct gRPC streaming"
-            )
+        # channel technology, VariantsRDD.scala:26,210-211). Mirror/
+        # cache and binary-frame tiers ride the shared protocol
+        # (genomics/mirror.py, genomics/wire.py), so --cache-dir/
+        # --mirror-mode work identically on both transports.
         from spark_examples_tpu.genomics.grpc_transport import (
             GrpcVariantSource,
             grpc_available,
@@ -109,6 +103,8 @@ def _network_source(args):
             idle_timeout=idle if idle else None,
             retry_policy=retry_policy,
             breakers=breakers(f"grpc:{args.api_url}:"),
+            cache_dir=getattr(args, "cache_dir", None),
+            mirror_mode=getattr(args, "mirror_mode", "full"),
         )
     return HttpVariantSource(
         args.api_url,

@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import json
 import os
-import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterator, List, Optional
@@ -242,6 +241,79 @@ def _make_handler(source, token: Optional[str]):
                                 q.get("variant_set_id", ""), shard
                             )
                         )
+                elif url.path == "/variants-csr":
+                    # Binary columnar wire tier (genomics/wire.py): the
+                    # shard's (indices, offsets) CSR pair sliced
+                    # straight off the sidecar and shipped as
+                    # checksummed binary frames — no per-record JSON
+                    # anywhere on this path (the protobuf-bulk-channel
+                    # analog, VariantsRDD.scala:242-252). 404 when the
+                    # source cannot serve ordinal CSR; clients then
+                    # fall back to the record tier.
+                    from spark_examples_tpu.genomics import wire
+
+                    frame_fn = getattr(
+                        source, "stream_carrying_frame", None
+                    )
+                    order_fn = getattr(source, "callset_order", None)
+                    if frame_fn is None or order_fn is None:
+                        self.send_error(
+                            404, "source does not serve CSR frames"
+                        )
+                        return
+                    shard = Shard(
+                        q["contig"], int(q["start"]), int(q["end"])
+                    )
+                    min_af = (
+                        float(q["min_af"]) if "min_af" in q else None
+                    )
+                    ident = getattr(source, "cohort_identity", None)
+                    ident = ident() if ident else None
+                    body = wire.encode_shard_frames(
+                        shard,
+                        frame_fn(
+                            q.get("variant_set_id", ""), shard, min_af
+                        ),
+                        # str() like every sibling call site: the
+                        # digest must be computed over the SAME
+                        # normalized ids /callset-order serves.
+                        wire.callsets_digest(
+                            [str(c) for c in order_fn()]
+                        ),
+                        ident,
+                    )
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/x-sxcf-frames"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif url.path == "/callset-order":
+                    # The ordinal id table frame payloads index into
+                    # (clients fetch it once, remap frames locally).
+                    from spark_examples_tpu.genomics import wire
+
+                    order_fn = getattr(source, "callset_order", None)
+                    if order_fn is None:
+                        self.send_error(
+                            404, "source has no callset order"
+                        )
+                        return
+                    ids = [str(c) for c in order_fn()]
+                    body = (
+                        json.dumps(
+                            {
+                                "ids": ids,
+                                "digest": wire.callsets_digest(ids),
+                            }
+                        )
+                        + "\n"
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif url.path == "/reads":
                     shard = Shard(
                         q["contig"], int(q["start"]), int(q["end"])
@@ -369,11 +441,19 @@ class HttpVariantSource:
     enforceShardBoundary server contract; the builder re-applies the
     contig rule defensively).
 
-    Two wire-efficiency tiers close the gap to the reference's binary
-    gRPC streaming (``VariantsRDD.scala:26,210-211``):
+    Three wire-efficiency tiers close the gap to the reference's binary
+    gRPC streaming (``VariantsRDD.scala:26,210-211,242-252``):
 
-    - streams are gzip-encoded end to end when the server supports it
-      (~10× fewer bytes for JSONL; on by default, transparent);
+    - record streams are gzip-encoded end to end when the server
+      supports it (~10× fewer bytes for JSONL; on by default);
+    - the fused CSR ingest path (``stream_carrying_csr``, the default
+      ``pca`` route) rides the BINARY FRAME tier when the server speaks
+      it: one checksummed binary frame per shard carrying the
+      ``(indices, offsets)`` CSR pair in callset ordinals — no
+      per-record JSON serialize/parse anywhere on the path
+      (:mod:`spark_examples_tpu.genomics.wire`). A server without
+      ``/variants-csr`` silently degrades to the record tier;
+      ``wire_frames=False`` is the client-side kill switch.
     - with ``cache_dir`` set, the WHOLE cohort is mirrored locally once —
       keyed by the server's ``/identity`` content digest (the ETag
       analog) — and every subsequent call is served by a local
@@ -381,7 +461,9 @@ class HttpVariantSource:
       warm tier (~100× over re-parse, zero network) to remote cohorts.
       A changed server cohort changes the identity and triggers a fresh
       mirror; a server without ``/identity`` silently degrades to direct
-      streaming.
+      streaming. (The mirror protocol itself is transport-agnostic —
+      :mod:`spark_examples_tpu.genomics.mirror` — and shared with the
+      gRPC source.)
     """
 
     def __init__(
@@ -394,6 +476,7 @@ class HttpVariantSource:
         mirror_mode: str = "full",
         retry_policy=None,
         breakers=None,
+        wire_frames: bool = True,
     ):
         if mirror_mode not in ("full", "light"):
             raise ValueError(
@@ -426,6 +509,17 @@ class HttpVariantSource:
         # Shard-parallel ingest resolves the mirror from worker threads;
         # the download must happen exactly once, not raced.
         self._mirror_lock = threading.Lock()
+        # Binary frame tier state: the server's callset-ordinal order
+        # ((ids, digest) | False = server has no frame tier | None =
+        # unprobed) and the single-slot ordinal→dense-index lookup
+        # cache (identity-keyed on the run's shared indexes dict, like
+        # _CsrCohort's).
+        from spark_examples_tpu.genomics.wire import OrdinalLookupCache
+
+        self._wire_frames = wire_frames
+        self._frame_order = None
+        self._frame_lock = threading.Lock()
+        self._frame_lookup = OrdinalLookupCache()
         # Keep-alive: one persistent HTTP/1.1 connection PER WORKER
         # THREAD (an all-autosomes manifest is ~2,900 shard requests per
         # host; a fresh TCP handshake per shard is pure overhead on real
@@ -563,7 +657,10 @@ class HttpVariantSource:
     def _resolve_mirror(self):
         """JsonlSource over the local mirror, downloading it first if this
         identity has never been mirrored; False = caching unavailable
-        (no cache_dir, or server without /identity)."""
+        (no cache_dir, or server without /identity). The protocol
+        itself lives in :mod:`spark_examples_tpu.genomics.mirror`
+        (transport-agnostic; the gRPC source shares it) — this method
+        supplies the HTTP feed and the once-only locking."""
         if self._mirror is not None:
             return self._mirror
         if not self._cache_dir:
@@ -572,238 +669,169 @@ class HttpVariantSource:
         with self._mirror_lock:
             if self._mirror is not None:
                 return self._mirror
-            self._mirror = self._resolve_mirror_locked()
+            from spark_examples_tpu.genomics.mirror import resolve_mirror
+
+            self._mirror = resolve_mirror(
+                _HttpMirrorFeed(self),
+                self._cache_dir,
+                self._mirror_mode,
+                self.stats,
+            )
             return self._mirror
 
-    def _resolve_mirror_locked(self):
-        try:
-            with self._request("/identity", {}) as resp:
-                ident = json.load(resp)["identity"]
-        except IOError as e:
-            # ONLY a served 404 (older server / unidentifiable source)
-            # degrades to direct streaming; transport trouble or auth
-            # failure must surface here, not silently disable the cache
-            # for a multi-thousand-shard run.
-            if _http_code(e) == 404:
-                return False
-            raise
-        root = os.path.join(self._cache_dir, f"cohort-{ident}")
-        if not os.path.exists(os.path.join(root, MIRROR_COMPLETE_MARKER)):
-            self._download_mirror(root, ident)
-        elif self._mirror_mode == "full" and not (
-            os.path.exists(os.path.join(root, "variants.jsonl"))
-            or os.path.exists(os.path.join(root, "variants.jsonl.gz"))
-        ):
-            # A LIGHT mirror from an earlier run, asked to serve full:
-            # upgrade in place by fetching the missing interchange
-            # files (atomic per file) instead of crashing the first
-            # record-streaming consumer on cache internals.
-            self._upgrade_light_mirror(root)
-        from spark_examples_tpu.genomics.sources import JsonlSource
+    # -- binary frame tier --------------------------------------------------
 
-        return JsonlSource(root, stats=self.stats)
+    def _probe_request(self, path: str):
+        """A capability probe: the same wire/retry/breaker path as
+        ``_request`` but INVISIBLE to IoStats — probes are
+        infrastructure, not data-plane requests, and the six
+        accumulators are pinned reference parity (a default run against
+        an older server must not report an unsuccessful response it
+        semantically never had)."""
+        from spark_examples_tpu.resilience import (
+            call_with_retry,
+            classify_http,
+        )
 
-    def _upgrade_light_mirror(self, root: str) -> None:
-        # reads BEFORE variants: the upgrade gate in _resolve_mirror_locked
-        # keys on variants.jsonl's presence, and replacing it LAST makes
-        # the gate re-fire after any interrupted upgrade — fetching
-        # variants first would mark the mirror "full" with reads.jsonl
-        # permanently missing.
-        staged = []  # (tmp path, final name), commit-ordered
-        try:
-            for name in ("reads.jsonl", "variants.jsonl"):
-                if os.path.exists(os.path.join(root, name)):
-                    continue
-                try:
-                    resp = self._request(
-                        f"/export/{name}", {}, stream=True
-                    )
-                except IOError as e:
-                    if name == "reads.jsonl" and _http_code(e) == 404:
-                        continue  # reads are optional in the layout
-                    raise
-                tmp = os.path.join(
-                    root, f".partial-{name}-{os.getpid()}"
-                )
-                staged.append((tmp, name))
-                with open(tmp, "wb") as out:
-                    for line in self._stream_lines(
-                        resp, f"/export/{name}"
-                    ):
-                        out.write(line)
-                        out.write(b"\n")
-            if not staged:
-                return
-            # The upgrade downloaded over a window in which the server
-            # cohort may have CHANGED — the same TOCTOU window
-            # _download_mirror re-verifies. At all-autosomes scale the
-            # download runs for hours; a mid-upgrade cohort swap would
-            # leave the OLD sidecar (vouched forever by .sidecar-ok)
-            # next to NEW JSONL, and the fused/CSR tier and the
-            # record-streaming tier would silently serve different
-            # cohorts. Verify BEFORE committing anything: files land in
-            # the mirror only after /identity still matches the pin, so
-            # a failure anywhere in this window leaves the prior light
-            # mirror untouched (never unverified files that a later run
-            # would trust forever).
-            expect = None
-            try:
-                with open(os.path.join(root, MIRROR_IDENTITY_FILE)) as f:
-                    expect = f.read().strip()
-            except OSError:
-                pass  # mirrors always carry it; no pin → can't verify
-            with self._request("/identity", {}) as resp:
-                now_ident = json.load(resp)["identity"]
-            if expect is not None and now_ident != expect:
-                raise IOError(
-                    "server cohort changed while upgrading mirror "
-                    f"(identity {expect} -> {now_ident}); the upgrade "
-                    "was discarded — rerun to mirror the new cohort"
-                )
-            # Commit order (reads before variants, the staged list's
-            # order): variants.jsonl's presence is the upgrade gate, so
-            # replacing it LAST makes the gate re-fire after a crash
-            # between the two commits.
-            for tmp, name in staged:
-                os.replace(tmp, os.path.join(root, name))
-        finally:
-            for tmp, _ in staged:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
+        target = self._url.path + path
+        headers = {}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        return call_with_retry(
+            lambda: self._one_attempt(path, target, headers),
+            self._retry_policy,
+            classify_http,
+            transport="http",
+            method=path,
+            breaker=self._breakers.get(path),
+        )
 
-    def _download_mirror(self, root: str, ident: str) -> None:
-        """Atomically populate ``root`` with the served cohort's
-        interchange files: download into a temp dir, mark complete,
-        rename. A crash mid-download leaves only a temp dir that can
-        never be mistaken for a mirror; a populate race is resolved by
-        whichever process renames first (identical content by identity).
-
-        When the server exports its binary CSR sidecar, it ships too —
-        the mirror's first fused access then skips the cold parse
-        entirely. The sidecar can never match the mirror's file stats
-        (fresh mtimes; possibly decompressed sizes), so the
-        ``.identity``/``.sidecar-ok`` pair records that the MIRROR
-        PROTOCOL vouches for it (see _CsrCohort._mirror_sidecar_trusted).
-
-        ``mirror_mode="light"`` downloads ONLY callsets.json + the
-        sidecar — at BASELINE-4 scale a ~2.7 GB npz instead of a
-        ~57.7 GB JSONL, and the only remote warm tier that fits hosts
-        with less free disk than the cohort. A light mirror serves the
-        fused/CSR ingest tiers (the default ``pca`` path end to end);
-        record-streaming consumers (--debug-datasets, search-variants)
-        need ``mirror_mode="full"``. The sidecar is then mandatory: a
-        server that cannot export one fails the mirror rather than
-        leaving a directory that can serve nothing.
-        """
-        import shutil
-        import tempfile
-
-        light = self._mirror_mode == "light"
-        os.makedirs(self._cache_dir, exist_ok=True)
-        tmp = tempfile.mkdtemp(dir=self._cache_dir, prefix=".mirror-")
-        try:
-            names = (
-                ("callsets.json",)
-                if light
-                else ("callsets.json", "variants.jsonl", "reads.jsonl")
-            )
-            for name in names:
-                try:
-                    resp = self._request(
-                        f"/export/{name}", {}, stream=True
-                    )
-                except IOError as e:
-                    if name == "reads.jsonl" and _http_code(e) == 404:
-                        continue  # reads are optional in the layout
-                    raise
-                with open(os.path.join(tmp, name), "wb") as out:
-                    for line in self._stream_lines(
-                        resp, f"/export/{name}"
-                    ):
-                        out.write(line)
-                        out.write(b"\n")
-            with open(os.path.join(tmp, MIRROR_IDENTITY_FILE), "w") as f:
-                f.write(ident)
-            try:
-                resp = self._request("/export-sidecar", {})
-                # Content-Length is enforced by http.client: a premature
-                # EOF raises (IncompleteRead) instead of leaving a
-                # silently truncated npz; even then, an unreadable file
-                # just falls back to a local rebuild.
-                with resp, open(
-                    os.path.join(tmp, SIDECAR_BASENAME), "wb"
-                ) as out:
-                    shutil.copyfileobj(resp, out)
-                with open(
-                    os.path.join(tmp, MIRROR_SIDECAR_OK), "w"
-                ) as f:
-                    f.write(ident)
-            except (IOError, OSError) as e:
-                if light:
-                    # A light mirror WITHOUT the sidecar can serve
-                    # nothing (there is no JSONL to parse) — fail the
-                    # mirror instead of renaming a husk into place.
-                    raise IOError(
-                        "light mirror requires the server's sidecar "
-                        f"export, which failed: {e}"
-                    ) from e
-                # Otherwise the sidecar is a pure optimization; its
-                # failure must never destroy the mandatory JSONL mirror
-                # already on disk. A cold server may even time out here
-                # (its ensure_sidecar parses the whole cohort before
-                # responding) — the client then just parses locally.
-                if _http_code(e) != 404:
-                    print(
-                        f"WARNING: sidecar export failed ({e}); the "
-                        "mirror will parse locally instead.",
-                        file=sys.stderr,
-                    )
-                for name in (SIDECAR_BASENAME, MIRROR_SIDECAR_OK):
+    def _frame_order_ids(self):
+        """(ids, digest) from /callset-order — the ordinal table frame
+        payloads index into — or False when the server has no frame
+        tier (older server: the client degrades to the record tier,
+        like a missing /identity degrades the mirror)."""
+        if not self._wire_frames:
+            return False
+        if self._frame_order is None:
+            with self._frame_lock:
+                if self._frame_order is None:
                     try:
-                        os.remove(os.path.join(tmp, name))
-                    except OSError:
-                        pass
-            # The mirror's files downloaded over a window in which the
-            # server cohort may have CHANGED (mixing old JSONL with a new
-            # sidecar — or new JSONL tail with old head). Re-verify the
-            # identity before marking complete: a swap mid-download makes
-            # the whole mirror junk, trusted sidecar or not.
-            with self._request("/identity", {}) as resp:
-                now_ident = json.load(resp)["identity"]
-            if now_ident != ident:
-                raise IOError(
-                    "server cohort changed while mirroring "
-                    f"(identity {ident} -> {now_ident}); rerun to mirror "
-                    "the new cohort"
-                )
-            open(os.path.join(tmp, MIRROR_COMPLETE_MARKER), "w").close()
-            try:
-                os.rename(tmp, root)
-            except OSError:
-                # Lost a populate race: the winner's mirror is identical
-                # by identity — never touch an existing complete root
-                # (another process may be reading it right now).
-                if not os.path.exists(os.path.join(root, MIRROR_COMPLETE_MARKER)):
-                    raise
-                shutil.rmtree(tmp, ignore_errors=True)
-        except BaseException:
-            shutil.rmtree(tmp, ignore_errors=True)
+                        with self._probe_request(
+                            "/callset-order"
+                        ) as resp:
+                            doc = json.load(resp)
+                        self._frame_order = (
+                            [str(i) for i in doc["ids"]],
+                            str(doc["digest"]),
+                        )
+                    except IOError as e:
+                        if _http_code(e) == 404:
+                            self._frame_order = False
+                        else:
+                            raise
+        return self._frame_order
+
+    def _ordinal_lookup(self, indexes: dict):
+        """(lookup array, ids, digest) for the run's shared indexes
+        dict (wire.OrdinalLookupCache)."""
+        ids, digest = self._frame_order_ids()
+        return self._frame_lookup.get(ids, indexes), ids, digest
+
+    def _frame_carrying_csr(
+        self, variant_set_id, shard, indexes, min_allele_frequency
+    ):
+        """CSR ingest over the binary frame tier: one checksummed frame
+        stream per shard, fetched+decoded as ONE retryable operation —
+        a corrupted or truncated frame fails the CRC/end-frame check
+        loudly and the whole shard re-fetches per policy, never a
+        silent record drop (the guarantee the JSON tier gets from its
+        end-frame protocol)."""
+        import http.client
+        import time as _time
+
+        from spark_examples_tpu import obs
+        from spark_examples_tpu.genomics import wire
+        from spark_examples_tpu.resilience import (
+            CircuitOpenError,
+            call_with_retry,
+            classify_http,
+            faults,
+        )
+
+        path = "/variants-csr"
+        lookup, ids, digest = self._ordinal_lookup(indexes)
+        params = {
+            "variant_set_id": variant_set_id,
+            "contig": shard.contig,
+            "start": shard.start,
+            "end": shard.end,
+        }
+        if min_allele_frequency is not None:
+            params["min_af"] = repr(float(min_allele_frequency))
+        target = self._url.path + path + f"?{urlencode(params)}"
+        headers = {}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        self.stats.add(
+            requests=1, partitions=1, reference_bases=shard.range
+        )
+
+        def attempt():
+            t0 = _time.perf_counter()
+            with obs.span("wire_frame_fetch", shard=str(shard)):
+                resp = self._one_attempt(path, target, headers)
+                decoder = wire.FrameDecoder(expect_digest=digest)
+                frames = []
+                try:
+                    with resp:
+                        chunks = iter(lambda: resp.read(1 << 20), b"")
+                        # Chaos seam: stream-shaped faults (truncate/
+                        # corrupt) on the frame bytes land here; the
+                        # CRC + end-frame checks are what detects them.
+                        for chunk in faults.wrap_lines(
+                            "transport.http.frames", chunks, key=path
+                        ):
+                            frames.extend(decoder.feed(chunk))
+                    decoder.finish()
+                except (http.client.HTTPException, OSError) as e:
+                    # Transport abort or a decode failure mid-body: the
+                    # kept-alive socket may hold unread bytes — poison.
+                    self._drop_connection()
+                    if isinstance(e, wire.WireFormatError):
+                        raise
+                    raise IOError(
+                        f"{path}: frame stream aborted mid-shard: {e}"
+                    ) from e
+            wire.note_frame_metrics(
+                "http",
+                decoder.frames,
+                decoder.bytes,
+                _time.perf_counter() - t0,
+            )
+            return frames
+
+        try:
+            frames = call_with_retry(
+                attempt,
+                self._retry_policy,
+                classify_http,
+                transport="http",
+                method=path,
+                breaker=self._breakers.get(path),
+            )
+        except IOError as e:
+            if isinstance(e, CircuitOpenError) or _http_code(e) is None:
+                self.stats.add(io_exceptions=1)
+            else:
+                self.stats.add(unsuccessful_responses=1)
             raise
-        # Identity keys on (size, mtime): a regenerated-but-identical
-        # server file still mints a new identity, so prune the now-stale
-        # sibling mirrors or cache_dir grows without bound. Only after a
-        # SUCCESSFUL download — the cold path already moved the whole
-        # cohort, a stale reader losing its files mid-run is the rare
-        # case pruning-on-warm would make common.
-        base = os.path.basename(root)
-        for entry in os.listdir(self._cache_dir):
-            if entry.startswith("cohort-") and entry != base:
-                shutil.rmtree(
-                    os.path.join(self._cache_dir, entry),
-                    ignore_errors=True,
-                )
+        self.stats.add(
+            variants_read=sum(
+                int(h.get("variants_read", 0)) for h, _, _ in frames
+            )
+        )
+        return wire.remap_frames(frames, lookup, ids, shard)
 
     # -- source protocol ----------------------------------------------------
 
@@ -944,15 +972,22 @@ class HttpVariantSource:
         indexes: dict,
         min_allele_frequency=None,
     ):
-        """CSR-direct fused ingest for remote cohorts: served straight
-        off a mirrored sidecar when the cache holds one (zero network,
-        zero parse — the tier that makes warm remote all-autosomes runs
-        match local ones), else assembled from the wire's fused record
-        stream (same semantics, one (indices, offsets) pair per shard).
-        None for an empty shard window, like the local tier."""
+        """CSR-direct fused ingest for remote cohorts, tiered fastest
+        first: a mirrored sidecar when the cache holds one (zero
+        network, zero parse — the tier that makes warm remote
+        all-autosomes runs match local ones); else the BINARY FRAME
+        tier when the server speaks it (sidecar-slice speed over the
+        wire, no per-record JSON — genomics/wire.py); else assembled
+        from the wire's JSON record stream (same semantics, one
+        (indices, offsets) pair per shard). None for an empty shard
+        window, like the local tier."""
         mirror = self._resolve_mirror()
         if mirror:
             return mirror.stream_carrying_csr(
+                variant_set_id, shard, indexes, min_allele_frequency
+            )
+        if self._frame_order_ids():
+            return self._frame_carrying_csr(
                 variant_set_id, shard, indexes, min_allele_frequency
             )
         from spark_examples_tpu.genomics.sources import (
@@ -1018,3 +1053,58 @@ class HttpVariantSource:
         for line in self._stream_lines(resp, "/reads"):
             self.stats.add(reads_read=1)
             yield read_from_record(json.loads(line))
+
+
+class _HttpMirrorFeed:
+    """The HTTP transport behind the shared mirror protocol
+    (genomics/mirror.py): /identity, framed /export/<name> line
+    streams, and the Content-Length-enforced /export-sidecar download.
+    Served 404s map to the protocol's absent-export signals; transport
+    trouble and auth failures surface — they must never silently
+    disable the cache for a multi-thousand-shard run."""
+
+    def __init__(self, source: HttpVariantSource):
+        self._src = source
+
+    def identity(self) -> Optional[str]:
+        try:
+            with self._src._request("/identity", {}) as resp:
+                return json.load(resp)["identity"]
+        except IOError as e:
+            if _http_code(e) == 404:
+                return None  # older server / unidentifiable source
+            raise
+
+    def export_lines(self, name: str):
+        from spark_examples_tpu.genomics.mirror import ExportUnavailable
+
+        try:
+            resp = self._src._request(f"/export/{name}", {}, stream=True)
+        except IOError as e:
+            if _http_code(e) == 404:
+                raise ExportUnavailable(str(e)) from e
+            raise
+        return self._src._stream_lines(resp, f"/export/{name}")
+
+    def export_sidecar(self):
+        from spark_examples_tpu.genomics.mirror import ExportUnavailable
+
+        try:
+            resp = self._src._request("/export-sidecar", {})
+        except IOError as e:
+            if _http_code(e) == 404:
+                raise ExportUnavailable(str(e)) from e
+            raise
+
+        def chunks():
+            # Content-Length is enforced by http.client: a premature
+            # EOF raises (IncompleteRead) instead of yielding a
+            # silently truncated npz.
+            with resp:
+                while True:
+                    block = resp.read(1 << 20)
+                    if not block:
+                        return
+                    yield block
+
+        return chunks()

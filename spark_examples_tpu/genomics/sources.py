@@ -560,6 +560,50 @@ class FixtureSource:
             min_allele_frequency,
         )
 
+    def callset_order(self) -> List[str]:
+        """Callset ids in ORDINAL order — the id space binary wire
+        frames index into (for a fixture, construction order)."""
+        return [c.id for c in self._callsets]
+
+    def stream_carrying_frame(
+        self,
+        variant_set_id: str,
+        shard: Shard,
+        min_allele_frequency: Optional[float] = None,
+    ):
+        """One shard's carrying CSR pair in callset ORDINALS plus the
+        variants_read count — the binary wire tier's payload
+        (genomics/wire.py). Ordinals are positions in
+        :meth:`callset_order`; the CLIENT remaps them to its dense
+        sample indexes, exactly as the sidecar tier does, because the
+        dense index is config-dependent and the order is not. Same
+        stats/fault-injection behavior as :meth:`stream_carrying`; the
+        count rides separately so the serving transport can forward it
+        (client IoStats must match the record tiers)."""
+        items = self._shard_items(shard)
+        ord_of = {c.id: i for i, c in enumerate(self._callsets)}
+        priv = IoStats()
+        if any(isinstance(i, Variant) for i in items):
+            lists = _carrying_variants(
+                self._built(items, variant_set_id),
+                ord_of,
+                priv,
+                min_allele_frequency,
+            )
+        else:
+            lists = _carrying_records(
+                items, ord_of, variant_set_id, priv, min_allele_frequency
+            )
+        pair = csr_pair_from_lists(lists)
+        self.stats.add(variants_read=priv.variants_read)
+        if pair is None:
+            return (
+                np.zeros(0, dtype=np.int64),
+                np.zeros(1, dtype=np.int64),
+                priv.variants_read,
+            )
+        return pair[0], pair[1], priv.variants_read
+
     def stream_reads(
         self, read_group_set_id: str, shard: Shard
     ) -> Iterator[Read]:
@@ -1550,6 +1594,10 @@ class JsonlSource:
         self.root = root
         self.stats = stats if stats is not None else IoStats()
         self._csr: Optional[_CsrCohort] = None
+        # Ordinal identity map for the binary wire tier: ONE dict object
+        # reused across shard requests so _CsrCohort's single-slot
+        # lookup cache (identity-keyed) hits on every frame query.
+        self._ordinal_indexes: Optional[dict] = None
         # Shard-parallel ingest streams from worker threads; every
         # lazily-built shared structure (sidecar, record indexes) must be
         # built exactly once, not once per racing worker.
@@ -1614,6 +1662,12 @@ class JsonlSource:
             os.path.join(self.root, "reads.jsonl")
         ) or os.path.exists(os.path.join(self.root, "reads.jsonl.gz")):
             self._reads_index()
+        # The CSR sidecar backs the binary frame tier (one slice per
+        # /variants-csr request) and the sidecar export — a lazy
+        # whole-cohort parse behind the first client's socket timeout
+        # is exactly the failure the line-index warm fixed. Persisted,
+        # so only the first serve of a cohort pays it.
+        self._ensure_csr()
         idx = self._line_index()
         if idx is not None:
             return idx.total
@@ -1822,6 +1876,63 @@ class JsonlSource:
                 self.stats,
                 min_allele_frequency,
             )
+
+    def callset_order(self) -> List[str]:
+        """Callset ids in ORDINAL order — the id space binary wire
+        frames index into: callsets.json file order plus any sidecar
+        extras (ids seen in records but absent from callsets.json),
+        exactly the sidecar's own ordinal table."""
+        return [
+            str(c)
+            for c in self._ensure_csr()._d["callset_ids"].tolist()
+        ]
+
+    def stream_carrying_frame(
+        self,
+        variant_set_id: str,
+        shard: Shard,
+        min_allele_frequency: Optional[float] = None,
+    ):
+        """One shard's carrying CSR pair in callset ORDINALS plus the
+        variants_read count — the binary wire tier's payload, sliced
+        straight off the sidecar with an identity ordinal map (zero
+        parse, zero remap server-side; the CLIENT remaps to its dense
+        indexes, like the local sidecar tier). Row/stats/AF semantics
+        are exactly :meth:`stream_carrying_csr`'s."""
+        from spark_examples_tpu.obs import rpc_timer
+
+        csr = self._ensure_csr()
+        if self._ordinal_indexes is None:
+            with self._lazy_lock:
+                if self._ordinal_indexes is None:
+                    self._ordinal_indexes = {
+                        str(cid): i
+                        for i, cid in enumerate(
+                            csr._d["callset_ids"].tolist()
+                        )
+                    }
+        priv = IoStats()
+        with rpc_timer("jsonl", "stream_carrying_frame"):
+            pair = csr.carrying_csr(
+                shard,
+                self._ordinal_indexes,
+                variant_set_id,
+                priv,
+                min_allele_frequency,
+            )
+        self.stats.add(
+            partitions=1,
+            requests=1,
+            reference_bases=shard.range,
+            variants_read=priv.variants_read,
+        )
+        if pair is None:
+            return (
+                np.zeros(0, dtype=np.int64),
+                np.zeros(1, dtype=np.int64),
+                priv.variants_read,
+            )
+        return pair[0], pair[1], priv.variants_read
 
     def stream_carrying_keyed(
         self,

@@ -76,6 +76,16 @@ class VariantsPcaDriver:
             raise ValueError(
                 "--elastic-checkpoint requires --checkpoint-dir"
             )
+        if getattr(conf, "ingest_order", "manifest") not in (
+            "manifest",
+            "completion",
+        ):
+            # argparse choices only guard the CLI (same reasoning as
+            # pca_mode below).
+            raise ValueError(
+                f"ingest_order must be 'manifest' or 'completion'; got "
+                f"{conf.ingest_order!r}"
+            )
         if conf.pca_mode not in ("auto", "fused", "stream"):
             # argparse choices only guard the CLI; a programmatic typo
             # ('streaming', 'Stream') would otherwise silently fall
@@ -318,11 +328,23 @@ class VariantsPcaDriver:
 
     def get_csr_fused(self):
         """Fused single-dataset ingest as per-shard CSR pairs — the
-        vectorized twin of :meth:`get_calls_fused` (same manifest order,
-        filters, and stats; ~85% of warm host wall-clock at
-        all-autosomes scale was the per-variant list round-trip this
-        skips)."""
+        vectorized twin of :meth:`get_calls_fused` (same filters and
+        stats; ~85% of warm host wall-clock at all-autosomes scale was
+        the per-variant list round-trip this skips).
+
+        ``--ingest-order completion`` feeds pairs in SHARD-COMPLETION
+        order instead of manifest order: the fetch+decode workers (the
+        remote binary-frame tier's pool) hand each shard to the device
+        accumulator the moment it lands, so one slow shard never
+        head-of-line-blocks the stream. Safe because the Gramian
+        accumulates exact integer co-occurrence counts (every count sits
+        far below 2^24, the f32 exact-integer bound), so G is
+        bit-identical under any arrival order — pinned by test. Block
+        COMPOSITION differs, which is why checkpointed modes (snapshot
+        digests cut at manifest positions) always keep manifest order.
+        """
         from spark_examples_tpu.utils.concurrency import (
+            completion_parallel_map,
             ordered_parallel_map,
         )
 
@@ -344,9 +366,13 @@ class VariantsPcaDriver:
                 ),
             )
 
-        yield from ordered_parallel_map(
-            extract, shards, self._ingest_workers()
+        pmap = (
+            completion_parallel_map
+            if getattr(self.conf, "ingest_order", "manifest")
+            == "completion"
+            else ordered_parallel_map
         )
+        yield from pmap(extract, shards, self._ingest_workers())
 
     def _fused_multi_possible(self) -> bool:
         """Keyed fused ingest for multi-dataset join/merge: identity
@@ -491,12 +517,25 @@ class VariantsPcaDriver:
         )
         return self._gramian_from_block_stream(blocks)
 
+    @staticmethod
+    def _cancellable_blocks(blocks):
+        """Soft-deadline seam (utils/softcancel.py): the check sits at
+        BLOCK boundaries — between one accumulation step and the next —
+        so a run-wrapper deadline (scripts/tpu_run.sh) cancels with no
+        device dispatch in flight, never the mid-dispatch SIGKILL that
+        wedges the relay."""
+        from spark_examples_tpu.utils import softcancel
+
+        for block in blocks:
+            softcancel.check("gramian block boundary")
+            yield block
+
     def _gramian_from_block_stream(self, blocks):
         # One armed phase for the whole uncheckpointed accumulation: the
         # timeout must budget full ingest (use checkpointed rounds for
         # finer granularity on long runs).
         with self._watchdog().armed("ingest+gramian collectives"):
-            g = self._blocks_to_gramian(blocks)
+            g = self._blocks_to_gramian(self._cancellable_blocks(blocks))
             if jax.process_count() > 1 and not self._mesh_spans_processes():
                 # Host-local accumulation (no global mesh): merge the
                 # per-host partials over DCN. The global-mesh path needs
@@ -616,6 +655,11 @@ class VariantsPcaDriver:
 
         every = max(1, self.conf.checkpoint_every)
         while done < len(shards):
+            # Between groups a snapshot is already on disk — the ideal
+            # soft-cancel point: exit here loses zero completed work.
+            from spark_examples_tpu.utils import softcancel
+
+            softcancel.check("checkpoint group boundary")
             group = shards[done : done + every]
             g = self._ingest_shard_group(vsid, group, g)
             done += len(group)
@@ -830,7 +874,12 @@ class VariantsPcaDriver:
                     f"Min allele frequency "
                     f"{self.conf.min_allele_frequency}."
                 )
+        from spark_examples_tpu.utils import softcancel
+
         for u in my_units:
+            # Between units the lane snapshot covers everything done —
+            # soft-cancel here loses zero completed work.
+            softcancel.check("elastic unit boundary")
             lo, hi = units[u]
             if multi:
                 g = np.asarray(

@@ -122,6 +122,16 @@ class PcaConfig(GenomicsConfig):
     # setting — the ordered map preserves manifest order into the
     # accumulator.
     ingest_workers: int = 0
+    # Shard arrival order into the Gramian accumulator on the CSR-direct
+    # ingest tier: "manifest" preserves exact manifest order (head-of-
+    # line blocking, byte-identical block packing — the historical
+    # behavior); "completion" feeds shards as their fetch+decode
+    # completes, so a slow remote shard never stalls the device behind
+    # it. G is bit-identical either way (integer-exact accumulation —
+    # pinned by test); only block composition and wall-clock change.
+    # Checkpointed modes keep manifest order (snapshot digests cut at
+    # manifest positions).
+    ingest_order: str = "manifest"
     # Spark-style speculative execution for straggler shards: when the
     # head-of-line extraction runs far past the median, a duplicate
     # attempt races it and the winner's (identical) result is used.
@@ -310,6 +320,18 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         "ingest; 0 = auto, one per core capped at 16 to bound peak memory; "
         "1 = serial). Results are bit-identical at any setting; only "
         "wall-clock changes",
+    )
+    p.add_argument(
+        "--ingest-order",
+        choices=("manifest", "completion"),
+        default=PcaConfig.ingest_order,
+        help="Shard arrival order into the Gramian accumulator on the "
+        "CSR-direct ingest tier: 'manifest' (default) preserves exact "
+        "manifest order; 'completion' feeds shards as their "
+        "fetch+decode completes — the remote binary-frame tier's "
+        "throughput mode, where a slow shard never stalls the device. "
+        "G is bit-identical either way (integer-exact accumulation); "
+        "checkpointed runs always use manifest order",
     )
     p.add_argument(
         "--speculative-ingest",

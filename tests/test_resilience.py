@@ -1254,11 +1254,17 @@ class TestChaosHarness:
                 trace_out=trace, metrics_out=metrics, manifest_out=manifest
             ):
                 with faults.active_plan(plan):
+                    # wire_frames=False: this scenario exercises the
+                    # JSON record tier's framing seams
+                    # (transport.http.stream); the binary frame tier
+                    # has its own chaos coverage in
+                    # tests/test_wire_format.py::TestFrameFaults.
                     http = HttpVariantSource(
                         f"http://127.0.0.1:{server.port}",
                         retry_policy=RetryPolicy(
                             max_attempts=4, base_delay=0.01, jitter=0.0
                         ),
+                        wire_frames=False,
                     )
                     result = VariantsPcaDriver(
                         _chaos_conf(shard_retries=4), http
