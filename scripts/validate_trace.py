@@ -38,6 +38,18 @@ __all__ = [
 # Chrome trace-event phases this system emits.
 _KNOWN_PHASES = {"X", "B", "E", "i", "I", "C", "M"}
 
+# Ingest sub-phase span contract (the parallel native ingest engine):
+# every `ingest.<sub>` span must be one of these — a typo'd sub-phase
+# name would silently vanish from the stage attribution the next
+# capture window relies on. (`ingest+gramian`, the driver STAGE name,
+# is not an `ingest.` span and is unaffected.)
+_INGEST_SPANS = {
+    "ingest.slice",  # CSR pairs -> per-block windows
+    "ingest.build",  # window -> packed block (native scatter / numpy)
+    "ingest.pack",   # legacy densified-block host pack
+    "ingest.put",    # device staging inside the prefetch feed
+}
+
 # Prometheus exposition line shapes (text format 0.0.4).
 _PROM_COMMENT = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ?.*$")
 _PROM_SAMPLE = re.compile(
@@ -88,6 +100,15 @@ def validate_trace(path: str) -> List[str]:
             continue
         if not isinstance(ev.get("name"), str) or not ev["name"]:
             errors.append(f"{where}: missing/empty name")
+        elif (
+            ev["name"].startswith("ingest.")
+            and ev["name"] not in _INGEST_SPANS
+        ):
+            errors.append(
+                f"{where}: unknown ingest sub-phase span "
+                f"{ev['name']!r} (expected one of "
+                f"{sorted(_INGEST_SPANS)})"
+            )
         if not isinstance(ev.get("pid"), int):
             errors.append(f"{where}: pid must be an int")
         if ph != "M":
@@ -111,6 +132,13 @@ def validate_trace(path: str) -> List[str]:
 _WIRE_COUNTERS = ("wire_frames_total", "wire_frame_bytes_total")
 _WIRE_HISTOGRAM = "wire_frame_decode_seconds"
 
+# Parallel-ingest metric contract: the block counter carries a mode
+# label ("native"/"python" — which build path produced the block), and
+# the build-latency histogram exposes the full Prometheus triplet.
+# Checked only when present, like the wire metrics.
+_INGEST_COUNTERS = ("ingest_blocks_built_total",)
+_INGEST_HISTOGRAM = "ingest_block_build_seconds"
+
 
 def _check_wire_metrics(path: str, sample_lines: List[str]) -> List[str]:
     errors: List[str] = []
@@ -123,13 +151,21 @@ def _check_wire_metrics(path: str, sample_lines: List[str]) -> List[str]:
                 f"{path}: {name} sample missing its transport label: "
                 f"{line!r}"
             )
-    if f"{_WIRE_HISTOGRAM}_bucket" in names:
-        for suffix in ("_sum", "_count"):
-            if f"{_WIRE_HISTOGRAM}{suffix}" not in names:
-                errors.append(
-                    f"{path}: {_WIRE_HISTOGRAM} histogram exposes "
-                    f"buckets but no {suffix} series"
-                )
+        if (
+            name in _INGEST_COUNTERS
+            or name.startswith(_INGEST_HISTOGRAM)
+        ) and 'mode="' not in line:
+            errors.append(
+                f"{path}: {name} sample missing its mode label: {line!r}"
+            )
+    for hist in (_WIRE_HISTOGRAM, _INGEST_HISTOGRAM):
+        if f"{hist}_bucket" in names:
+            for suffix in ("_sum", "_count"):
+                if f"{hist}{suffix}" not in names:
+                    errors.append(
+                        f"{path}: {hist} histogram exposes "
+                        f"buckets but no {suffix} series"
+                    )
     return errors
 
 

@@ -1,9 +1,22 @@
-"""Ingest → device: dense genotype blocks and double-buffered feeds."""
+"""Ingest → device: genotype blocks (dense and bit-packed) and
+double-buffered feeds."""
 
 from spark_examples_tpu.arrays.blocks import (
     blocks_from_calls,
+    blocks_from_csr,
+    csr_windows,
     densify_calls,
+    packed_block_from_csr,
+    packed_blocks_from_csr,
     DEFAULT_BLOCK_VARIANTS,
 )
 
-__all__ = ["blocks_from_calls", "densify_calls", "DEFAULT_BLOCK_VARIANTS"]
+__all__ = [
+    "blocks_from_calls",
+    "blocks_from_csr",
+    "csr_windows",
+    "densify_calls",
+    "packed_block_from_csr",
+    "packed_blocks_from_csr",
+    "DEFAULT_BLOCK_VARIANTS",
+]

@@ -51,17 +51,20 @@ def device_prefetch(
         return False
 
     def produce() -> None:
+        from spark_examples_tpu import obs
+
         try:
             for block in blocks:
                 if stop.is_set():
                     return
                 target = sharding if sharding is not None else device
                 arr = np.asarray(block)
-                staged = (
-                    jax.device_put(arr, target)
-                    if target is not None
-                    else jax.device_put(arr)
-                )
+                with obs.span("ingest.put", bytes=int(arr.nbytes)):
+                    staged = (
+                        jax.device_put(arr, target)
+                        if target is not None
+                        else jax.device_put(arr)
+                    )
                 if not _put(staged):
                     return
         except BaseException as e:  # noqa: BLE001 — re-raised in consumer

@@ -86,6 +86,17 @@ class VariantsPcaDriver:
                 f"ingest_order must be 'manifest' or 'completion'; got "
                 f"{conf.ingest_order!r}"
             )
+        if getattr(conf, "prefetch_depth", 2) < 1:
+            # A zero/negative staging depth would deadlock the bounded
+            # feed queue — refuse before any ingest work.
+            raise ValueError(
+                f"--prefetch-depth must be >= 1, got {conf.prefetch_depth}"
+            )
+        if conf.ingest_workers < 0:
+            raise ValueError(
+                f"--ingest-workers must be >= 1 (or 0 = auto), got "
+                f"{conf.ingest_workers}"
+            )
         if conf.pca_mode not in ("auto", "fused", "stream"):
             # argparse choices only guard the CLI; a programmatic typo
             # ('streaming', 'Stream') would otherwise silently fall
@@ -230,6 +241,54 @@ class VariantsPcaDriver:
         if self.conf.ingest_workers:
             return self.conf.ingest_workers
         return min(os.cpu_count() or 1, 16)
+
+    def _block_builder_workers(self) -> int:
+        """Builder threads for the packed-block production stage
+        (``--ingest-workers``; auto = min(4, cores), 1 → serial).
+
+        A separate, smaller auto cap than shard extraction: each builder
+        holds exactly one packed block (N × ⌈Vb/8⌉ bytes, 8× less than
+        an extraction worker's call lists) but the stage is pure memory
+        bandwidth — past ~4 threads the scatter saturates the memory
+        controller, not the cores. On a single-core host auto = 1, the
+        serial in-order path, so the CLI default is byte-identical to
+        the historical pipeline there.
+        """
+        if self.conf.ingest_workers:
+            return self.conf.ingest_workers
+        return min(os.cpu_count() or 1, 4)
+
+    def _build_attempt(self, thunk, key: str):
+        """Run one packed-block build under the resilience layer — the
+        ``ingest.build`` fault seam (a builder worker dying mid-block)
+        plus up to ``--shard-retries`` attempts. Sound because the build
+        is a pure function of its already-sliced window: a retry yields
+        a byte-identical block, so a worker death can change wall-clock,
+        never G — and a block is either built or the run fails loudly
+        (no silent drop). Default (1 attempt, no plan): zero overhead.
+        """
+        from spark_examples_tpu import resilience
+        from spark_examples_tpu.resilience import faults
+
+        retries = max(1, getattr(self.conf, "shard_retries", 1))
+        if retries <= 1 and faults.current_plan() is None:
+            return thunk()
+
+        def attempt():
+            faults.inject("ingest.build", key=key)
+            return thunk()
+
+        return resilience.call_with_retry(
+            attempt,
+            resilience.RetryPolicy(
+                max_attempts=retries,
+                base_delay=0.05,
+                deadline=getattr(self.conf, "shard_retry_deadline", None),
+            ),
+            resilience.classify_ingest,
+            transport="ingest",
+            method="build",
+        )
 
     def _shard_attempt(self, shard, fn):
         """Run one idempotent shard extraction under the resilience
@@ -458,8 +517,9 @@ class VariantsPcaDriver:
             return self.conf.sample_sharded
         return self.index.size > self.conf.sample_shard_threshold
 
-    def _blocks_to_gramian(self, blocks, g_init=None):
+    def _blocks_to_gramian(self, blocks, g_init=None, prepacked=False):
         n = self.index.size
+        depth = getattr(self.conf, "prefetch_depth", 2)
         if self._mesh_spans_processes():
             # Pod mode: the mesh covers every process; each host feeds its
             # manifest slice as the process-local shard of global blocks
@@ -471,25 +531,34 @@ class VariantsPcaDriver:
 
             if self._sample_sharded():
                 g = sharded_gramian_blockwise_global(
-                    blocks, n, self.mesh, packed=True
+                    blocks, n, self.mesh, packed=True, prefetch_depth=depth
                 )
             else:
                 g = gramian_blockwise_global(
-                    blocks, n, self.mesh, packed=True
+                    blocks, n, self.mesh, packed=True, prefetch_depth=depth
                 )
         elif self.mesh is not None:
             from spark_examples_tpu.parallel.sharded import (
                 sharded_gramian_blockwise,
             )
 
-            g = sharded_gramian_blockwise(blocks, n, self.mesh, packed=True)
+            g = sharded_gramian_blockwise(
+                blocks, n, self.mesh, packed=True, prefetch_depth=depth
+            )
         else:
             # packed=True: blocks_from_calls yields 0/1 indicators, so the
             # bit-packed transfer (8× fewer host→device bytes; on-chip
             # measured 4.5× on the blockwise phase, PERFORMANCE.md) is
             # bit-identical and strictly faster on any bandwidth-bound
-            # link.
-            g = gramian_blockwise(blocks, n, packed=True)
+            # link. prepacked: the native ingest engine already produced
+            # packbits bytes — the feed skips the host pack entirely.
+            g = gramian_blockwise(
+                blocks,
+                n,
+                packed=True,
+                prepacked=prepacked,
+                prefetch_depth=depth,
+            )
         if g_init is not None:
             g = g + jax.numpy.asarray(g_init, dtype=g.dtype)
         return g
@@ -509,7 +578,31 @@ class VariantsPcaDriver:
     def get_similarity_matrix_csr(self, csr_pairs):
         """CSR-direct twin of :meth:`get_similarity_matrix` — identical
         blocks bit-for-bit (pinned by tests), built by vectorized scatter
-        instead of per-variant Python lists."""
+        instead of per-variant Python lists.
+
+        On the replicated-G (meshless) route the blocks are produced by
+        the PARALLEL NATIVE INGEST ENGINE: ``--ingest-workers`` builder
+        threads scatter bit-packed panels directly from the sidecar
+        ``(indices, offsets)`` windows (native ``csr_to_packed_blocks``
+        releases the GIL; no int8 densify intermediate), feeding
+        completion-order into the double-buffered device feed — G is
+        bit-identical under any block arrival order (integer-exact
+        accumulation, pinned by test). Mesh layouts keep the int8 block
+        stream (their accumulators pad the sample axis before packing).
+        """
+        if self.mesh is None:
+            from spark_examples_tpu.arrays.blocks import (
+                packed_blocks_from_csr,
+            )
+
+            blocks = packed_blocks_from_csr(
+                csr_pairs,
+                self.index.size,
+                self.conf.block_variants,
+                workers=self._block_builder_workers(),
+                attempt=self._build_attempt,
+            )
+            return self._gramian_from_block_stream(blocks, prepacked=True)
         from spark_examples_tpu.arrays.blocks import blocks_from_csr
 
         blocks = blocks_from_csr(
@@ -530,12 +623,14 @@ class VariantsPcaDriver:
             softcancel.check("gramian block boundary")
             yield block
 
-    def _gramian_from_block_stream(self, blocks):
+    def _gramian_from_block_stream(self, blocks, prepacked=False):
         # One armed phase for the whole uncheckpointed accumulation: the
         # timeout must budget full ingest (use checkpointed rounds for
         # finer granularity on long runs).
         with self._watchdog().armed("ingest+gramian collectives"):
-            g = self._blocks_to_gramian(self._cancellable_blocks(blocks))
+            g = self._blocks_to_gramian(
+                self._cancellable_blocks(blocks), prepacked=prepacked
+            )
             if jax.process_count() > 1 and not self._mesh_spans_processes():
                 # Host-local accumulation (no global mesh): merge the
                 # per-host partials over DCN. The global-mesh path needs
@@ -1118,8 +1213,6 @@ class VariantsPcaDriver:
         Prefers the CSR-direct tier (bit-identical blocks — parity
         pinned — so snapshots and resume digests are unaffected)."""
         if self._fused_csr_possible():
-            from spark_examples_tpu.arrays.blocks import blocks_from_csr
-
             pairs = (
                 self._shard_attempt(
                     shard,
@@ -1132,6 +1225,28 @@ class VariantsPcaDriver:
                 )
                 for shard in group
             )
+            if self.mesh is None:
+                # Same parallel native packed production as the
+                # uncheckpointed route: snapshots cut at GROUP
+                # boundaries, and within a group G is bit-identical
+                # under any block completion order, so resume digests
+                # are unaffected.
+                from spark_examples_tpu.arrays.blocks import (
+                    packed_blocks_from_csr,
+                )
+
+                blocks = packed_blocks_from_csr(
+                    pairs,
+                    self.index.size,
+                    self.conf.block_variants,
+                    workers=self._block_builder_workers(),
+                    attempt=self._build_attempt,
+                )
+                return self._blocks_to_gramian(
+                    blocks, g_init=g, prepacked=True
+                )
+            from spark_examples_tpu.arrays.blocks import blocks_from_csr
+
             blocks = blocks_from_csr(
                 pairs, self.index.size, self.conf.block_variants
             )

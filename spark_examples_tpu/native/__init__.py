@@ -8,13 +8,36 @@ cover both paths).
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
 import subprocess
 import threading
 from typing import Optional
 
-__all__ = ["load", "native_available", "CohortCsr"]
+__all__ = ["load", "native_available", "force_fallback", "CohortCsr"]
+
+
+@contextlib.contextmanager
+def force_fallback():
+    """Force the pure-Python/numpy fallback paths for the duration.
+
+    Sets the ``SPARK_EXAMPLES_TPU_NO_NATIVE`` kill switch — which
+    ``load()`` re-checks on every call, so the toggle works mid-process
+    — and RESTORES any pre-existing value on exit (the CI fallback lane
+    exports it run-wide; popping it would silently re-enable the native
+    path for everything after the first caller). The one helper the
+    tests and bench share, so the env contract can't drift between
+    copies."""
+    old = os.environ.get("SPARK_EXAMPLES_TPU_NO_NATIVE")
+    os.environ["SPARK_EXAMPLES_TPU_NO_NATIVE"] = "1"
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("SPARK_EXAMPLES_TPU_NO_NATIVE", None)
+        else:
+            os.environ["SPARK_EXAMPLES_TPU_NO_NATIVE"] = old
 
 
 class CohortCsr(ctypes.Structure):
@@ -113,6 +136,18 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.c_void_p,
         ]
+        if hasattr(lib, "csr_to_packed_blocks"):
+            # Absent from pre-PR-6 deployed .so files; callers probe
+            # with hasattr and fall back to the numpy pack.
+            lib.csr_to_packed_blocks.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+            ]
+            lib.csr_to_packed_blocks.restype = ctypes.c_int64
         lib.murmur3_x64_128.argtypes = [
             ctypes.c_void_p,
             ctypes.c_int64,

@@ -37,6 +37,35 @@ void pack_calls(const int64_t* indices, const int64_t* offsets,
   }
 }
 
+// Scatter one CSR window straight into a BIT-PACKED block: sample s
+// carrying variant column v sets bit (0x80 >> (v & 7)) of byte
+// out[s * stride_bytes + (v >> 3)] — np.packbits bit order (MSB first),
+// so the output is byte-identical to
+// np.packbits(densify(indices, offsets), axis=1). Skipping the int8
+// densify intermediate is 8x less memory traffic on the hottest host
+// loop of ingest (PERFORMANCE.md round-5: 38.7 s single-threaded).
+// out must be a zeroed (n_samples, stride_bytes) row-major uint8 buffer
+// with stride_bytes >= ceil(n_variants / 8) (column-padded blocks keep
+// their pad bits zero — inert in the Gramian).
+// Returns 0, or 1 when any index falls outside [0, n_samples) — the
+// caller raises; a silent skip would drop a carrier from G.
+int64_t csr_to_packed_blocks(const int64_t* indices, const int64_t* offsets,
+                             int64_t n_variants, int64_t n_samples,
+                             int64_t stride_bytes, uint8_t* out) {
+  for (int64_t v = 0; v < n_variants; ++v) {
+    const int64_t byte = v >> 3;
+    const uint8_t bit = static_cast<uint8_t>(0x80u >> (v & 7));
+    for (int64_t k = offsets[v]; k < offsets[v + 1]; ++k) {
+      const int64_t s = indices[k];
+      if (s < 0 || s >= n_samples) {
+        return 1;
+      }
+      out[s * stride_bytes + byte] |= bit;
+    }
+  }
+  return 0;
+}
+
 static inline uint64_t rotl64(uint64_t x, int8_t r) {
   return (x << r) | (x >> (64 - r));
 }

@@ -232,6 +232,7 @@ def gramian_blockwise(
     device=None,
     packed: bool = False,
     prepacked: bool = False,
+    prefetch_depth: int = 2,
 ):
     """Stream variant blocks through ``G += X_blk @ X_blk.T`` on device.
 
@@ -248,7 +249,11 @@ def gramian_blockwise(
         (reference ``VariantsCommon.scala:38-50``).
       prepacked: with ``packed=True``, the blocks are ALREADY
         ``pack_indicator_block`` output (uint8 bytes) — skip the host
-        pack (callers that keep a packed cohort resident).
+        pack (callers that keep a packed cohort resident, and the
+        native ingest engine's direct-packed block production).
+      prefetch_depth: device-feed staging depth (``--prefetch-depth``):
+        how many transferred blocks the double-buffered prefetch keeps
+        ahead of the accumulating matmul.
 
     Returns:
       ``(N, N)`` device Gramian.
@@ -267,11 +272,20 @@ def gramian_blockwise(
         # which are inert in X @ X.T.
         def packed_stream():
             for xb in blocks:
-                yield xb if prepacked else pack_indicator_block(xb)
+                if prepacked:
+                    yield xb
+                else:
+                    # Span closed BEFORE the yield: it must time the
+                    # pack, not the consumer's turn of the generator.
+                    with obs.span("ingest.pack"):
+                        xp = pack_indicator_block(xb)
+                    yield xp
 
         with obs.span("gramian_blockwise", packed=True):
             for i, xp in enumerate(
-                device_prefetch(packed_stream(), device=device)
+                device_prefetch(
+                    packed_stream(), depth=prefetch_depth, device=device
+                )
             ):
                 if i == 0:
                     record_compiled(
@@ -289,7 +303,9 @@ def gramian_blockwise(
                 )
         return g
     with obs.span("gramian_blockwise", packed=False):
-        for i, xb in enumerate(device_prefetch(blocks, device=device)):
+        for i, xb in enumerate(
+            device_prefetch(blocks, depth=prefetch_depth, device=device)
+        ):
             if i == 0:
                 record_compiled(
                     "gramian_accumulate",

@@ -130,6 +130,7 @@ def _accumulate_blocks(
     compute_dtype,
     accum_dtype,
     packed: bool = False,
+    prefetch_depth: int = 2,
 ):
     """Shared blockwise-Gramian core: pad, zero-init, accumulate, trim.
 
@@ -287,7 +288,9 @@ def _accumulate_blocks(
     else:
         from spark_examples_tpu.arrays.feed import device_prefetch
 
-        stream = device_prefetch(padded_blocks(), sharding=x_sharding)
+        stream = device_prefetch(
+            padded_blocks(), depth=prefetch_depth, sharding=x_sharding
+        )
     for xb in stream:
         g = _accum(g, xb)
     if n_padded == n_samples:
@@ -306,6 +309,7 @@ def sharded_gramian_blockwise(
     accum_dtype=jnp.float32,
     compute_dtype=None,
     packed: bool = False,
+    prefetch_depth: int = 2,
 ):
     """Stream variant blocks into a mesh-sharded Gramian accumulator.
 
@@ -324,6 +328,7 @@ def sharded_gramian_blockwise(
         compute_dtype,
         accum_dtype,
         packed=packed,
+        prefetch_depth=prefetch_depth,
     )
 
 
@@ -384,6 +389,7 @@ def gramian_blockwise_global(
     compute_dtype=None,
     accum_dtype=jnp.float32,
     packed: bool = False,
+    prefetch_depth: int = 2,
 ):
     """Multi-controller blockwise Gramian: one mesh spanning every process.
 
@@ -412,6 +418,7 @@ def gramian_blockwise_global(
         compute_dtype,
         accum_dtype,
         packed=packed,
+        prefetch_depth=prefetch_depth,
     )
 
 
@@ -527,6 +534,7 @@ def sharded_gramian_blockwise_global(
     compute_dtype=None,
     accum_dtype=jnp.float32,
     packed: bool = False,
+    prefetch_depth: int = 2,
 ):
     """Pod-mode blockwise Gramian with G *sample-sharded* over the mesh.
 
@@ -554,6 +562,7 @@ def sharded_gramian_blockwise_global(
         compute_dtype,
         accum_dtype,
         packed=packed,
+        prefetch_depth=prefetch_depth,
     )
 
 

@@ -118,10 +118,20 @@ class PcaConfig(GenomicsConfig):
     # chip time at stress N.
     eig_tol: Optional[float] = None
     # Shard-parallel host ingest workers (fused paths): 0 = auto (core
-    # count capped at 16), 1 = serial. Results are bit-identical at any
-    # setting — the ordered map preserves manifest order into the
-    # accumulator.
+    # count capped at 16 for shard extraction; min(4, cores) for the
+    # packed-block builder stage), 1 = serial. Results are bit-identical
+    # at any setting — shard extraction preserves manifest order, and
+    # the block builders' completion-order output feeds an
+    # order-independent integer accumulation.
     ingest_workers: int = 0
+    # Device-feed staging depth (arrays/feed.device_prefetch): how many
+    # transferred blocks the double-buffered host→device feed keeps
+    # ahead of the accumulating matmul. Sharding-aware — applies to the
+    # replicated and host-local-mesh feeds alike (the process-spanning
+    # pod stream is collective lockstep and has no host-side depth).
+    # Must be >= 1; 2 (double buffering) is right unless block build
+    # latency is very bursty.
+    prefetch_depth: int = 2
     # Shard arrival order into the Gramian accumulator on the CSR-direct
     # ingest tier: "manifest" preserves exact manifest order (head-of-
     # line blocking, byte-identical block packing — the historical
@@ -316,10 +326,21 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         "--ingest-workers",
         type=int,
         default=0,
-        help="Threads extracting shards concurrently on the host (fused "
-        "ingest; 0 = auto, one per core capped at 16 to bound peak memory; "
-        "1 = serial). Results are bit-identical at any setting; only "
-        "wall-clock changes",
+        help="Threads extracting shards AND building packed genotype "
+        "blocks concurrently on the host (fused ingest; 0 = auto — one "
+        "per core capped at 16 for extraction, min(4, cores) for the "
+        "native block builders; 1 = serial; < 0 rejected). Results are "
+        "bit-identical at any setting; only wall-clock changes",
+    )
+    p.add_argument(
+        "--prefetch-depth",
+        type=int,
+        default=PcaConfig.prefetch_depth,
+        help="Blocks the double-buffered host→device feed stages ahead "
+        "of the accumulating matmul (default 2; must be >= 1). Applies "
+        "to the replicated and host-local-mesh feeds alike; the "
+        "process-spanning pod stream is collective lockstep and "
+        "ignores it",
     )
     p.add_argument(
         "--ingest-order",

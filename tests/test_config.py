@@ -52,6 +52,18 @@ def test_pca_extras():
     assert conf.checkpoint_dir == "/tmp/x" and conf.trace_dir == "/tmp/t"
 
 
+def test_ingest_pipeline_flags():
+    # Defaults: double-buffered feed, auto worker sizing.
+    conf = _parse([])
+    assert conf.prefetch_depth == 2
+    assert conf.ingest_workers == 0  # 0 = auto
+    conf = _parse(
+        ["--prefetch-depth", "4", "--ingest-workers", "3"]
+    )
+    assert conf.prefetch_depth == 4
+    assert conf.ingest_workers == 3
+
+
 def test_shards_partitioner_selection():
     conf = PcaConfig(bases_per_partition=50_000_000)
     brca1 = conf.shards(all_references=False)

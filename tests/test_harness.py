@@ -61,6 +61,65 @@ def test_device_prefetch_abandoned_consumer_releases_producer():
     assert len(n_produced) <= produced_after_close + 1
 
 
+def test_device_prefetch_producer_exception_at_depth_gt_2():
+    """A producer failure must surface in the consumer at ANY staging
+    depth — with depth > 2 several good blocks are already queued ahead
+    of the error, and all of them must still be delivered first."""
+    import numpy as np
+    import pytest
+
+    from spark_examples_tpu.arrays.feed import device_prefetch
+
+    def failing():
+        for i in range(5):
+            yield np.full((3, 3), i, np.int8)
+        raise IOError("builder worker died")
+
+    it = device_prefetch(failing(), depth=4)
+    got = []
+    with pytest.raises(IOError, match="builder worker died"):
+        for b in it:
+            got.append(int(np.asarray(b)[0, 0]))
+    assert got == [0, 1, 2, 3, 4]  # nothing staged was dropped
+
+
+def test_device_prefetch_consumer_cancel_at_depth_gt_2():
+    """Abandoning the consumer mid-stream with a deep queue must stop
+    the producer promptly: with depth > 2 a blocked q.put holds MORE
+    staged device blocks alive, so a leak here is depth× worse."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from spark_examples_tpu.arrays.feed import device_prefetch
+
+    started = threading.Event()
+    n_produced = []
+
+    def blocks():
+        for i in range(1000):
+            started.set()
+            n_produced.append(i)
+            yield np.zeros((32, 32), np.int8)
+
+    it = device_prefetch(blocks(), depth=5)
+    next(it)
+    started.wait(5)
+    it.close()  # consumer abandons with ~depth blocks staged
+    deadline = time.time() + 5
+    stable_at = None
+    while time.time() < deadline:
+        n = len(n_produced)
+        time.sleep(0.3)
+        if len(n_produced) == n:
+            stable_at = n
+            break
+    # Producer stopped well short of the stream (bounded by the window
+    # in flight when close() landed), not at exhaustion.
+    assert stable_at is not None and stable_at < 1000
+
+
 def test_int8_int32_gramian_exact():
     """int8 x int8 -> int32 einsum (the MXU int-matmul path) is exact and
     matches the f32 path."""

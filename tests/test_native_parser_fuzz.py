@@ -14,6 +14,7 @@ import numpy as np
 import pytest
 
 from spark_examples_tpu.genomics.sources import JsonlSource, _CsrCohort
+from spark_examples_tpu.native import force_fallback as _force_python_fallback
 from spark_examples_tpu.native import load
 
 CALLSET_IDS = [f"cs-{i}" for i in range(6)]
@@ -215,3 +216,112 @@ def test_adversarial_lines_mixed_with_valid(tmp_path):
     valid = [json.dumps(_random_record(rng)) for _ in range(5)]
     for i, line in enumerate(_adversarial_lines(rng)):
         _compare(tmp_path, valid + [line], f"mix{i}")
+
+
+class TestCsrToPackedBlocksFuzz:
+    """Differential fuzz for the native packed-block scatter: for ANY
+    CSR window, ``csr_to_packed_blocks`` must be byte-identical to the
+    numpy reference (densify → ``np.packbits``) — the fallback path —
+    and both must reject out-of-range indices identically. The packed
+    bytes ARE the device feed, so a single divergent bit is a wrong G.
+    """
+
+    @staticmethod
+    def _reference_pack(window_idx, lens, n_samples, block_variants):
+        """Densify + packbits — the historical composition the packed
+        path must reproduce bit-for-bit."""
+        cols = np.repeat(np.arange(lens.size, dtype=np.int64), lens)
+        x = np.zeros((n_samples, block_variants), dtype=np.int8)
+        x[window_idx, cols] = 1
+        return np.packbits(x.astype(bool), axis=1)
+
+    def _both_paths(self, window_idx, lens, n_samples, block_variants):
+        from spark_examples_tpu.arrays.blocks import packed_block_from_csr
+
+        native = packed_block_from_csr(
+            window_idx, lens, n_samples, block_variants
+        )
+        with _force_python_fallback():
+            python = packed_block_from_csr(
+                window_idx, lens, n_samples, block_variants
+            )
+        return native, python
+
+    @pytest.mark.parametrize("seed", range(20))
+    def test_random_windows_bit_identical(self, seed):
+        rng = np.random.default_rng(seed)
+        n_samples = int(rng.integers(1, 80))
+        block_variants = int(rng.integers(1, 70))
+        rows = int(rng.integers(0, block_variants + 1))
+        # Duplicate indices within a variant allowed: both the dense
+        # scatter and the bit-OR are idempotent, so they must agree.
+        lens = rng.integers(0, n_samples + 1, rows)
+        window_idx = (
+            rng.integers(0, n_samples, int(lens.sum()), dtype=np.int64)
+            if lens.sum()
+            else np.zeros(0, np.int64)
+        )
+        want = self._reference_pack(
+            window_idx, lens, n_samples, block_variants
+        )
+        native, python = self._both_paths(
+            window_idx, lens, n_samples, block_variants
+        )
+        assert native.dtype == np.uint8 and native.shape == want.shape
+        np.testing.assert_array_equal(native, want, err_msg=f"seed {seed}")
+        np.testing.assert_array_equal(python, want, err_msg=f"seed {seed}")
+
+    def test_empty_window(self):
+        native, python = self._both_paths(
+            np.zeros(0, np.int64), np.zeros(0, np.int64), 11, 16
+        )
+        assert native.shape == (11, 2) and not native.any()
+        np.testing.assert_array_equal(native, python)
+
+    def test_pad_columns_stay_zero(self):
+        # 3 real variants in a 21-wide block (21 → 3 packed bytes, 5 pad
+        # bits in the last byte): every pad bit must be zero — pad bits
+        # are only inert in the Gramian if they ARE zero.
+        lens = np.array([2, 0, 1], np.int64)
+        idx = np.array([0, 4, 3], np.int64)
+        want = self._reference_pack(idx, lens, 5, 21)
+        native, python = self._both_paths(idx, lens, 5, 21)
+        np.testing.assert_array_equal(native, want)
+        np.testing.assert_array_equal(python, want)
+        assert native[:, 0].max() > 0  # real bits landed
+        # Columns 3.. of the bit-unpacked form are pad.
+        assert not np.unpackbits(native, axis=1)[:, 3:].any()
+
+    def test_max_density_rows(self):
+        # Every sample carries every variant: all real bits set.
+        n, bv = 9, 24
+        lens = np.full(bv, n, np.int64)
+        idx = np.tile(np.arange(n, dtype=np.int64), bv)
+        native, python = self._both_paths(idx, lens, n, bv)
+        assert (native == 0xFF).all()
+        np.testing.assert_array_equal(native, python)
+
+    @pytest.mark.parametrize("bad", [-1, 7, 99])
+    def test_out_of_range_index_rejected(self, bad):
+        from spark_examples_tpu.arrays.blocks import packed_block_from_csr
+
+        lens = np.array([1], np.int64)
+        idx = np.array([bad], np.int64)
+        with pytest.raises(ValueError, match="out of range"):
+            packed_block_from_csr(idx, lens, 7, 8)
+        with _force_python_fallback():
+            with pytest.raises(ValueError, match="out of range"):
+                packed_block_from_csr(idx, lens, 7, 8)
+
+    def test_native_kernel_rejects_out_of_range_directly(self):
+        # The C routine's own guard (the Python wrapper checks first;
+        # this pins the double-guard so a corrupt window can never
+        # silently drop a carrier even if called raw).
+        lib = load()
+        idx = np.array([5], np.int64)
+        offs = np.array([0, 1], np.int64)
+        out = np.zeros((4, 1), np.uint8)
+        rc = lib.csr_to_packed_blocks(
+            idx.ctypes.data, offs.ctypes.data, 1, 4, 1, out.ctypes.data
+        )
+        assert rc == 1

@@ -495,3 +495,115 @@ class TestPipelineManifestEmission:
         doc = json.load(open(run_artifacts["trace"]))
         names = {e["name"] for e in doc["traceEvents"]}
         assert {"run", "ingest+gramian", "pca", "emit"} <= names
+
+
+class TestIngestSpanEmission:
+    """The parallel ingest engine's sub-phase observability: a CLI run
+    over a JSONL cohort (the CSR-direct route) must land `ingest.slice`
+    / `ingest.build` / `ingest.put` spans on the timeline and the
+    `ingest_blocks_built_total` / `ingest_block_build_seconds` series
+    in the metrics dump — and every artifact must pass the validator's
+    ingest schema checks."""
+
+    @pytest.fixture(scope="class")
+    def run_artifacts(self, tmp_path_factory):
+        from spark_examples_tpu.cli.main import main
+        from spark_examples_tpu.genomics.fixtures import synthetic_cohort
+
+        tmp_path = tmp_path_factory.mktemp("obs_ingest")
+        root = str(tmp_path / "cohort")
+        synthetic_cohort(10, 60, seed=3).dump(root)
+        paths = {
+            "trace": str(tmp_path / "run.trace.json"),
+            "metrics": str(tmp_path / "run.metrics.prom"),
+            "manifest": str(tmp_path / "run.manifest.json"),
+        }
+        old = os.environ.get("SPARK_EXAMPLES_TPU_COMPILE_CACHE")
+        os.environ["SPARK_EXAMPLES_TPU_COMPILE_CACHE"] = "0"
+        try:
+            rc = main(
+                [
+                    "pca",
+                    "--input-path",
+                    root,
+                    "--block-variants",
+                    "32",
+                    "--ingest-workers",
+                    "2",
+                    "--trace-out",
+                    paths["trace"],
+                    "--metrics-out",
+                    paths["metrics"],
+                    "--manifest-out",
+                    paths["manifest"],
+                ]
+            )
+        finally:
+            if old is None:
+                os.environ.pop("SPARK_EXAMPLES_TPU_COMPILE_CACHE", None)
+            else:
+                os.environ["SPARK_EXAMPLES_TPU_COMPILE_CACHE"] = old
+        assert rc == 0
+        return paths
+
+    def test_ingest_sub_phase_spans_present(self, run_artifacts):
+        doc = json.load(open(run_artifacts["trace"]))
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert {"ingest.slice", "ingest.build", "ingest.put"} <= names
+
+    def test_ingest_metrics_present_with_mode_label(self, run_artifacts):
+        prom = open(run_artifacts["metrics"]).read()
+        blocks = [
+            ln
+            for ln in prom.splitlines()
+            if ln.startswith("ingest_blocks_built_total")
+        ]
+        assert blocks and all('mode="' in ln for ln in blocks)
+        assert "ingest_block_build_seconds_bucket" in prom
+        assert "ingest_block_build_seconds_sum" in prom
+        assert "ingest_block_build_seconds_count" in prom
+
+    def test_artifacts_pass_ingest_schema_checks(self, run_artifacts):
+        assert validate.validate_trace(run_artifacts["trace"]) == []
+        assert validate.validate_metrics(run_artifacts["metrics"]) == []
+        assert validate.validate_manifest(run_artifacts["manifest"]) == []
+
+    def test_validator_rejects_unknown_ingest_span(self, tmp_path):
+        path = tmp_path / "bad.trace.json"
+        path.write_text(
+            json.dumps(
+                {
+                    "traceEvents": [
+                        {
+                            "ph": "X",
+                            "name": "ingest.densify",
+                            "pid": 1,
+                            "tid": 1,
+                            "ts": 0,
+                            "dur": 5,
+                        }
+                    ]
+                }
+            )
+        )
+        errs = validate.validate_trace(str(path))
+        assert errs and "ingest.densify" in errs[0]
+
+    def test_validator_rejects_modeless_ingest_counter(self, tmp_path):
+        path = tmp_path / "bad.metrics.prom"
+        path.write_text(
+            "# HELP ingest_blocks_built_total blocks\n"
+            "# TYPE ingest_blocks_built_total counter\n"
+            "ingest_blocks_built_total 5\n"
+        )
+        errs = validate.validate_metrics(str(path))
+        assert errs and "mode" in errs[0]
+
+    def test_manifest_carries_build_histogram(self, run_artifacts):
+        mf = json.load(open(run_artifacts["manifest"]))
+        hists = {
+            k: v
+            for k, v in mf["histograms"].items()
+            if k.startswith("ingest_block_build_seconds")
+        }
+        assert hists and any(v["count"] >= 1 for v in hists.values())

@@ -1332,6 +1332,44 @@ class TestChaosHarness:
         assert plan.fired_total == 4
         np.testing.assert_array_equal(_coords(result), _coords(baseline))
 
+    def test_block_builder_death_retried_bit_identical(
+        self, chaos_cohort
+    ):
+        """``ingest.build`` seam: a packed-block builder worker dying
+        mid-block is retried per the shard-retry policy; the rebuilt
+        block is byte-identical (the build is a pure function of its
+        window), so coordinates match the fault-free run exactly and no
+        block is ever silently dropped."""
+        root, baseline = chaos_cohort
+        plan = FaultPlan(
+            seed=29,
+            rules=[FaultRule(site="ingest.build", kind="error", times=2)],
+        )
+        with faults.active_plan(plan):
+            result = VariantsPcaDriver(
+                _chaos_conf(shard_retries=3), JsonlSource(root)
+            ).run()
+        assert plan.fired_total == 2
+        assert {f.site for f in plan.injected} == {"ingest.build"}
+        np.testing.assert_array_equal(_coords(result), _coords(baseline))
+
+    def test_block_builder_death_without_retries_fails_loudly(
+        self, chaos_cohort
+    ):
+        """With retries off the builder death must SURFACE (fail fast),
+        never drop the block and emit a silently-wrong G."""
+        root, _ = chaos_cohort
+        plan = FaultPlan(
+            seed=5,
+            rules=[FaultRule(site="ingest.build", kind="error", times=1)],
+        )
+        with faults.active_plan(plan):
+            with pytest.raises(IOError, match="ingest.build"):
+                VariantsPcaDriver(
+                    _chaos_conf(shard_retries=1), JsonlSource(root)
+                ).run()
+        assert plan.fired_total == 1
+
     def test_torn_checkpoint_writes_and_resume_identical(
         self, chaos_cohort, tmp_path
     ):
