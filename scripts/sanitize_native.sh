@@ -1,0 +1,99 @@
+#!/usr/bin/env bash
+# Build the native ingest core under a sanitizer and run a test suite
+# against the instrumented library — the dynamic complement to
+# graftlint's static native-gil rule (docs/STATIC_ANALYSIS.md).
+#
+# Usage:
+#   scripts/sanitize_native.sh asan  [pytest args...]
+#   scripts/sanitize_native.sh ubsan [pytest args...]
+#   scripts/sanitize_native.sh tsan  [pytest args...]
+#
+# Defaults: asan/ubsan run the native parser/pack fuzz suite
+# (tests/test_native_parser_fuzz.py); tsan runs the multi-worker ingest
+# acceptance suite (tests/test_parallel_ingest.py), the only consumer
+# that drives the GIL-released scatter from concurrent builder threads.
+#
+# The instrumented .so is built to a SEPARATE path and injected via
+# SPARK_EXAMPLES_TPU_NATIVE_SO, so the canonical _genomics_native.so is
+# never clobbered with a library that needs a preloaded runtime.
+#
+# FAILS LOUDLY when the toolchain can't produce an instrumented build —
+# a sanitizer job silently falling back to the numpy path would keep CI
+# green while covering nothing (mirroring the native-build gate in ci.yml).
+set -euo pipefail
+
+mode="${1:-}"
+shift || true
+repo_root="$(cd "$(dirname "$0")/.." && pwd)"
+src="$repo_root/spark_examples_tpu/native/genomics_native.cpp"
+build_dir="${SANITIZE_BUILD_DIR:-$repo_root/.sanitize}"
+mkdir -p "$build_dir"
+
+case "$mode" in
+  asan)
+    flags="-fsanitize=address -fno-omit-frame-pointer"
+    runtime_name="libasan.so"
+    default_tests="tests/test_native_parser_fuzz.py"
+    # Python itself "leaks" interned objects by design; leak checking a
+    # ctypes host process drowns real findings in interpreter noise.
+    export ASAN_OPTIONS="${ASAN_OPTIONS:-detect_leaks=0,abort_on_error=1}"
+    ;;
+  ubsan)
+    # Recoverable-off: any UB report is a hard failure, not a log line.
+    flags="-fsanitize=undefined -fno-sanitize-recover=all -fno-omit-frame-pointer"
+    runtime_name="libubsan.so"
+    default_tests="tests/test_native_parser_fuzz.py"
+    export UBSAN_OPTIONS="${UBSAN_OPTIONS:-print_stacktrace=1,halt_on_error=1}"
+    ;;
+  tsan)
+    flags="-fsanitize=thread"
+    runtime_name="libtsan.so"
+    # The concurrency surface TSan exists for: parallel builder threads
+    # driving the GIL-released native scatter (multiset identity) and
+    # the worker-death path. The jax-accumulating tests in the same file
+    # are serial-on-device and make TSan runs unboundedly slow — the CI
+    # job covers them uninstrumented.
+    default_tests="tests/test_parallel_ingest.py::TestPackedBlockProduction::test_multi_worker_block_multiset_identical tests/test_parallel_ingest.py::TestPackedBlockProduction::test_builder_exception_surfaces"
+    export TSAN_OPTIONS="${TSAN_OPTIONS:-halt_on_error=1}"
+    ;;
+  *)
+    echo "usage: $0 {asan|ubsan|tsan} [pytest args...]" >&2
+    exit 2
+    ;;
+esac
+
+command -v g++ >/dev/null || {
+  echo "FATAL: g++ not found — the sanitizer gate cannot run (this is a" >&2
+  echo "hard failure by design: a silent skip here covers nothing)." >&2
+  exit 3
+}
+
+so="$build_dir/_genomics_native.$mode.so"
+echo "[sanitize_native] building $so"
+# shellcheck disable=SC2086 — $flags is an intentional word list
+g++ -O1 -g -shared -fPIC -std=c++17 -pthread $flags "$src" -o "$so" || {
+  echo "FATAL: instrumented build failed for mode=$mode (toolchain" >&2
+  echo "missing the $mode runtime?) — failing the gate loudly." >&2
+  exit 3
+}
+
+# The sanitizer runtime must be in the process BEFORE the interpreter
+# dlopens the instrumented library (python is not itself instrumented).
+runtime="$(g++ -print-file-name="$runtime_name")"
+if [ "$runtime" = "$runtime_name" ]; then
+  echo "FATAL: g++ cannot locate $runtime_name — instrumented .so would" >&2
+  echo "fail at dlopen; failing the gate loudly." >&2
+  exit 3
+fi
+
+if [ "$#" -eq 0 ]; then
+  # shellcheck disable=SC2086 — the default is an intentional word list
+  set -- $default_tests
+fi
+echo "[sanitize_native] mode=$mode runtime=$runtime tests: $*"
+cd "$repo_root"
+LD_PRELOAD="$runtime" \
+SPARK_EXAMPLES_TPU_NATIVE_SO="$so" \
+JAX_PLATFORMS=cpu \
+python -m pytest "$@" -q -p no:cacheprovider
+echo "[sanitize_native] $mode: PASS"

@@ -33,7 +33,6 @@ def main() -> int:
     args = p.parse_args()
 
     import jax
-    import jax.numpy as jnp
 
     from spark_examples_tpu.utils.sync import host_sync
 

@@ -35,10 +35,6 @@ from urllib.parse import parse_qs, urlencode, urlparse
 from spark_examples_tpu.genomics.auth import Credentials
 from spark_examples_tpu.genomics.shards import Shard
 from spark_examples_tpu.genomics.sources import (
-    MIRROR_COMPLETE_MARKER,
-    MIRROR_IDENTITY_FILE,
-    MIRROR_SIDECAR_OK,
-    SIDECAR_BASENAME,
     Callset,
     _read_to_record,
     _variant_to_record,

@@ -28,7 +28,7 @@ import json
 import os
 import threading
 from dataclasses import dataclass
-from typing import Iterable, Iterator, List, Optional, Protocol, Sequence
+from typing import Iterator, List, Optional, Protocol, Sequence
 
 import numpy as np
 

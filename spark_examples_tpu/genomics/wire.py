@@ -231,7 +231,7 @@ class FrameDecoder:
             out.append(self._decode_data(header, payload))
             self.frames += 1
 
-    def _try_take_frame(self):
+    def _try_take_frame(self) -> Optional[Tuple[int, dict, bytes]]:
         """One complete frame off the buffer, or None (need more)."""
         buf = self._buf
         if len(buf) < _PREFIX.size:
@@ -377,7 +377,7 @@ class OrdinalLookupCache:
     array, never re-read the slot after publication (a racing thread
     with a different dict could have overwritten it) — lives once."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         # ONE slot attribute holding the (indexes, lookup) pair: the
         # pair is read and written atomically (a single reference), so
         # a racing writer with a different dict can never tear a

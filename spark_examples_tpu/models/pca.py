@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import os
 import sys
-from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+from typing import Iterable, Iterator, List, Sequence, Tuple
 
 import jax
 import numpy as np

@@ -10,7 +10,7 @@ one masked scatter-add instead of groupByKey chains.
 from __future__ import annotations
 
 import os
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 

@@ -113,6 +113,27 @@ def load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _tried:
             return _lib
+        # Alternate-library override (scripts/sanitize_native.sh): point
+        # the loader at a sanitizer-instrumented build WITHOUT touching
+        # the canonical .so — overwriting it in place would leave an
+        # ASan-instrumented library (which needs its runtime preloaded)
+        # for the next uninstrumented run to dlopen and die on. Checked
+        # BEFORE _tried is set: an override that cannot load must raise
+        # on EVERY call — caching the failure would hand every later
+        # caller a silent numpy fallback, the exact green-while-
+        # covering-nothing mode the sanitizer gate exists to prevent.
+        override = os.environ.get("SPARK_EXAMPLES_TPU_NATIVE_SO")
+        if override:
+            try:
+                lib = _bind(ctypes.CDLL(override))
+            except OSError as e:
+                raise OSError(
+                    f"SPARK_EXAMPLES_TPU_NATIVE_SO={override!r} did not "
+                    f"load: {e}"
+                ) from e
+            _lib = lib
+            _tried = True
+            return _lib
         _tried = True
         try:
             stale = not os.path.exists(_SO) or (
@@ -128,59 +149,64 @@ def load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_SO)
         except OSError:
             return None
-        lib.pack_calls.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_void_p,
-            ctypes.c_int64,
-            ctypes.c_int64,
-            ctypes.c_int64,
-            ctypes.c_void_p,
-        ]
-        if hasattr(lib, "csr_to_packed_blocks"):
-            # Absent from pre-PR-6 deployed .so files; callers probe
-            # with hasattr and fall back to the numpy pack.
-            lib.csr_to_packed_blocks.argtypes = [
-                ctypes.c_void_p,
-                ctypes.c_void_p,
-                ctypes.c_int64,
-                ctypes.c_int64,
-                ctypes.c_int64,
-                ctypes.c_void_p,
-            ]
-            lib.csr_to_packed_blocks.restype = ctypes.c_int64
-        lib.murmur3_x64_128.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_int64,
-            ctypes.c_uint64,
-            ctypes.c_void_p,
-        ]
-        lib.murmur3_x64_128_batch.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_void_p,
-            ctypes.c_int64,
-            ctypes.c_uint64,
-            ctypes.c_void_p,
-        ]
-        # Bind the cohort parser only when the library's struct layout
-        # matches this module's ctypes mirror: a deployed tree may ship
-        # an older .so, and reading an old struct through a newer layout
-        # would silently misalign every pointer after the changed field.
-        _ABI = 2
-        abi_ok = False
-        if hasattr(lib, "cohort_csr_abi_version"):
-            lib.cohort_csr_abi_version.restype = ctypes.c_int64
-            abi_ok = lib.cohort_csr_abi_version() == _ABI
-        if abi_ok and hasattr(lib, "parse_cohort_jsonl"):
-            lib.parse_cohort_jsonl.argtypes = [
-                ctypes.c_char_p,
-                ctypes.c_void_p,
-                ctypes.c_void_p,
-                ctypes.c_int64,
-            ]
-            lib.parse_cohort_jsonl.restype = ctypes.POINTER(CohortCsr)
-            lib.cohort_csr_free.argtypes = [ctypes.POINTER(CohortCsr)]
-        _lib = lib
+        _lib = _bind(lib)
         return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Declare the ctypes signatures on a freshly-dlopened library."""
+    lib.pack_calls.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_void_p,
+    ]
+    if hasattr(lib, "csr_to_packed_blocks"):
+        # Absent from pre-PR-6 deployed .so files; callers probe
+        # with hasattr and fall back to the numpy pack.
+        lib.csr_to_packed_blocks.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+        ]
+        lib.csr_to_packed_blocks.restype = ctypes.c_int64
+    lib.murmur3_x64_128.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_uint64,
+        ctypes.c_void_p,
+    ]
+    lib.murmur3_x64_128_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_uint64,
+        ctypes.c_void_p,
+    ]
+    # Bind the cohort parser only when the library's struct layout
+    # matches this module's ctypes mirror: a deployed tree may ship
+    # an older .so, and reading an old struct through a newer layout
+    # would silently misalign every pointer after the changed field.
+    _ABI = 2
+    abi_ok = False
+    if hasattr(lib, "cohort_csr_abi_version"):
+        lib.cohort_csr_abi_version.restype = ctypes.c_int64
+        abi_ok = lib.cohort_csr_abi_version() == _ABI
+    if abi_ok and hasattr(lib, "parse_cohort_jsonl"):
+        lib.parse_cohort_jsonl.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+        ]
+        lib.parse_cohort_jsonl.restype = ctypes.POINTER(CohortCsr)
+        lib.cohort_csr_free.argtypes = [ctypes.POINTER(CohortCsr)]
+    return lib
 
 
 def native_available() -> bool:

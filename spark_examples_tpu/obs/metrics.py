@@ -104,7 +104,7 @@ class _Metric:
                 child = self._children[key] = self._make_child()
             return child
 
-    def _make_child(self):
+    def _make_child(self) -> "_Metric":
         raise NotImplementedError
 
     def _items(self) -> List[Tuple[LabelItems, "_Metric"]]:
@@ -205,7 +205,9 @@ class Histogram(_Metric):
         finally:
             self.observe(time.perf_counter() - t0)
 
-    def _state(self):
+    def _state(
+        self,
+    ) -> Tuple[List[int], float, int, float, float]:
         with self._lock:
             return list(self._counts), self._sum, self._count, self._min, self._max
 

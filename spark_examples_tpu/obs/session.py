@@ -71,7 +71,10 @@ class TelemetrySession:
         _metrics.set_registry(self.registry)
         with _current_lock:
             _current = self
-        self._root = self.tracer.span("run", command=self.command)
+        # The one sanctioned bare span open in the tree: the run-root
+        # span's lifetime IS the session's, so open/close mirror
+        # __enter__/__exit__ instead of a `with` block.
+        self._root = self.tracer.span("run", command=self.command)  # graftlint: disable=span-contract
         self._root.__enter__()
         return self
 

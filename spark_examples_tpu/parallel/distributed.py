@@ -14,7 +14,6 @@ no-op fast path throughout: nothing here requires a cluster.
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 import jax
 import numpy as np

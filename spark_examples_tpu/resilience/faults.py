@@ -58,7 +58,7 @@ import os
 import random
 import threading
 import time
-from dataclasses import asdict, dataclass, field
+from dataclasses import asdict, dataclass
 from typing import Iterator, List, Optional, Sequence
 
 __all__ = [

@@ -7,7 +7,7 @@ from spark_examples_tpu.bridge import (
     PcaBridgeServer,
     TpuPcaBackend,
 )
-from spark_examples_tpu.ops import gramian, mllib_principal_components_reference
+from spark_examples_tpu.ops import mllib_principal_components_reference
 
 
 def _random_calls(n, v, seed=0):

@@ -17,7 +17,6 @@ not resume them. These tests pin the elastic contract:
   survivor, which Spark calls task re-execution.
 """
 
-import json
 import os
 import socket
 import subprocess

@@ -20,7 +20,6 @@ from spark_examples_tpu.genomics.fixtures import (
 )
 from spark_examples_tpu.genomics.shards import shards_for_references
 from spark_examples_tpu.genomics.sources import FixtureSource, JsonlSource
-from spark_examples_tpu.utils.stats import IoStats
 
 REFS = "17:41196311:41277499"
 

@@ -1,7 +1,5 @@
 """Unit tests for the messy-bit semantics (SURVEY.md §7 hard-parts #4)."""
 
-import numpy as np
-import pytest
 
 from spark_examples_tpu.genomics import (
     Call,
@@ -14,7 +12,6 @@ from spark_examples_tpu.genomics import (
 )
 from spark_examples_tpu.genomics.shards import (
     SexChromosomeFilter,
-    Shard,
     manifest_digest,
     parse_references,
     shards_for_all_references,

@@ -251,3 +251,63 @@ class TestNativeCohortParser:
                 assert a == b, name
             else:
                 np.testing.assert_array_equal(a, b, err_msg=name)
+
+
+class TestNativeSoOverride:
+    """SPARK_EXAMPLES_TPU_NATIVE_SO (the sanitizer-gate injection seam,
+    scripts/sanitize_native.sh): a valid override loads and binds; an
+    invalid one raises LOUDLY on EVERY load() call — caching the
+    failure would hand later callers a silent numpy fallback, turning
+    the sanitizer gate green while instrumenting nothing."""
+
+    def test_override_points_at_canonical_so_and_binds(self):
+        import subprocess
+        import sys
+
+        from spark_examples_tpu.native import _SO
+
+        code = (
+            "from spark_examples_tpu.native import load\n"
+            "lib = load()\n"
+            "assert lib is not None\n"
+            "assert hasattr(lib, 'pack_calls')\n"
+            "print('ok')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            env={
+                **__import__("os").environ,
+                "SPARK_EXAMPLES_TPU_NATIVE_SO": _SO,
+            },
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "ok" in proc.stdout
+
+    def test_bad_override_raises_on_every_call(self):
+        import subprocess
+        import sys
+
+        code = (
+            "from spark_examples_tpu.native import load\n"
+            "for attempt in range(2):\n"
+            "    try:\n"
+            "        load()\n"
+            "    except OSError as e:\n"
+            "        assert 'SPARK_EXAMPLES_TPU_NATIVE_SO' in str(e)\n"
+            "    else:\n"
+            "        raise SystemExit(f'silent fallback on attempt {attempt}')\n"
+            "print('raised twice')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            env={
+                **__import__("os").environ,
+                "SPARK_EXAMPLES_TPU_NATIVE_SO": "/nonexistent/lib.so",
+            },
+        )
+        assert proc.returncode == 0, proc.stderr + proc.stdout
+        assert "raised twice" in proc.stdout

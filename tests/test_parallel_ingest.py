@@ -22,7 +22,6 @@ import pytest
 from spark_examples_tpu.arrays.blocks import (
     blocks_from_csr,
     csr_windows,
-    packed_block_from_csr,
     packed_blocks_from_csr,
 )
 from spark_examples_tpu.native import force_fallback as _force_python_fallback

@@ -29,7 +29,6 @@ def reference_pipeline_numpy(source, conf):
     (ingest → scalar-loop Gramian → MLlib PCs) as the e2e golden."""
     from spark_examples_tpu.genomics.callsets import CallsetIndex
     from spark_examples_tpu.genomics.datasets import af_filter, calls_stream
-    from spark_examples_tpu.genomics.shards import SexChromosomeFilter
 
     index = CallsetIndex.from_source(source, conf.variant_set_ids)
     shards = conf.shards(all_references=conf.all_references)

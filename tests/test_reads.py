@@ -1,6 +1,5 @@
 """Reads kernels + the four example drivers (SearchReadsExample parity)."""
 
-import os
 
 import numpy as np
 import pytest

@@ -1,6 +1,5 @@
 """Search-variants example drivers (SearchVariantsExample parity)."""
 
-import pytest
 
 from spark_examples_tpu.genomics.sources import FixtureSource
 from spark_examples_tpu.models.search_variants import (

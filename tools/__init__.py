@@ -1,0 +1,5 @@
+"""Repo-local developer tooling (not shipped in the wheel).
+
+``tools.graftlint`` is the project-invariant static analyzer; run it
+from the repo root as ``python -m tools.graftlint spark_examples_tpu/``.
+"""

@@ -1,0 +1,38 @@
+"""graftlint: project-invariant static analysis for spark_examples_tpu.
+
+Generic linters cannot see this project's contracts: jit-purity of the
+device kernels, integer-exact dtype discipline on the Gramian path, the
+span/metric telemetry schema, the CLI-flag registry, resilience routing
+of every transport call, and the GIL-released native core staying clear
+of the Python C-API. Each of those is a *runtime* invariant today —
+enforced only by tests that must happen to exercise the offending path.
+graftlint proves them at review time instead.
+
+Usage (from the repo root)::
+
+    python -m tools.graftlint spark_examples_tpu/
+    python -m tools.graftlint --format jsonl spark_examples_tpu/
+    python -m tools.graftlint --list-rules
+
+Suppress a finding with a pragma on the offending line (or the line
+directly above it)::
+
+    x = host_only_helper()  # graftlint: disable=jit-purity
+
+or for a whole file (first 10 lines)::
+
+    # graftlint: disable-file=span-contract
+
+Suppressions are counted and reported — they are visible debt, not
+silence. Configuration lives in ``[tool.graftlint]`` in pyproject.toml;
+see docs/STATIC_ANALYSIS.md for every rule's rationale.
+"""
+
+from tools.graftlint.engine import (  # noqa: F401
+    Finding,
+    Project,
+    load_config,
+    run_lint,
+)
+
+__all__ = ["Finding", "Project", "load_config", "run_lint"]

@@ -1,0 +1,99 @@
+"""Small AST helpers shared by graftlint rules (stdlib ``ast`` only)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+__all__ = [
+    "dotted_name",
+    "call_name",
+    "walk_calls",
+    "is_jit_decorator",
+    "jitted_functions",
+    "literal_str",
+]
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Dotted name of a call's callee (``obs.span`` for ``obs.span(...)``)."""
+    return dotted_name(call.func)
+
+
+def walk_calls(node: ast.AST) -> Iterator[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    """True for ``jax.jit`` / ``jit`` / ``pjit`` / ``jax.pjit`` refs."""
+    name = dotted_name(node)
+    if name is None:
+        return False
+    last = name.rsplit(".", 1)[-1]
+    return last in ("jit", "pjit")
+
+
+def is_jit_decorator(dec: ast.expr) -> bool:
+    """Decorator forms that make the function body a traced program:
+    ``@jax.jit``, ``@jit``, ``@pjit``, ``@partial(jax.jit, ...)``,
+    ``@functools.partial(pjit, ...)``."""
+    if _is_jit_callable(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jit_callable(dec.func):
+            return True
+        fname = dotted_name(dec.func)
+        if fname and fname.rsplit(".", 1)[-1] == "partial" and dec.args:
+            return _is_jit_callable(dec.args[0])
+    return False
+
+
+def jitted_functions(tree: ast.AST) -> Iterator[ast.AST]:
+    """Every node whose body is traced: decorated (async) defs, plus
+    the lambda or (same-module) named-function reference in inline
+    ``jax.jit(f)`` call forms — ``jax.jit(_local)(x)`` traces
+    ``_local``'s body exactly like a decorator would. Cross-module
+    references cannot be resolved from one tree and are skipped."""
+    defs_by_name = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, node)
+    seen: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(is_jit_decorator(d) for d in node.decorator_list):
+                if id(node) not in seen:
+                    seen.add(id(node))
+                    yield node
+        elif isinstance(node, ast.Call) and _is_jit_callable(node.func):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Lambda):
+                    yield arg
+                elif (
+                    isinstance(arg, ast.Name)
+                    and arg.id in defs_by_name
+                    and id(defs_by_name[arg.id]) not in seen
+                ):
+                    target = defs_by_name[arg.id]
+                    seen.add(id(target))
+                    yield target
+
+
+def literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -1,0 +1,23 @@
+"""Golden negative for GL001 jit-purity: pure traced bodies, host work
+kept outside the trace."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_examples_tpu import obs
+
+
+@partial(jax.jit, static_argnames=("k",))
+def pure_kernel(x, k):
+    y = jnp.einsum("nv,mv->nm", x, x, preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * k
+
+
+def host_driver(blocks):
+    with obs.span("drive"):
+        for b in blocks:
+            arr = np.asarray(b)
+            yield pure_kernel(jnp.asarray(arr), 2)

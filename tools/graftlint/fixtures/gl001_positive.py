@@ -1,0 +1,38 @@
+"""Golden positive for GL001 jit-purity: every classic host-sync and
+trace-time side effect inside a jitted body."""
+
+from functools import partial
+
+import jax
+import numpy as np
+
+from spark_examples_tpu import obs
+
+
+@partial(jax.jit, static_argnames=("k",))
+def bad_kernel(x, k):
+    host = jax.device_get(x)  # host sync
+    np.asarray(x)  # host materialization
+    v = float(x)  # implicit device_get
+    print(v)  # trace-time-only side effect
+    with obs.span("bad_span"):  # trace-time-only telemetry
+        y = x * k
+    y.block_until_ready()  # host sync
+    return y
+
+
+def fine_host_helper(x):
+    # Outside any jit: all of this is legal host code.
+    arr = np.asarray(x)
+    print(float(arr[0]))
+    return arr
+
+
+inline_bad = jax.jit(lambda x: float(x))
+
+
+def _named_body(x):
+    return np.asarray(x)  # traced via the jax.jit(f) call form below
+
+
+named_bad = jax.jit(_named_body)

@@ -1,0 +1,10 @@
+"""Golden pragma-suppressed case for GL001 jit-purity."""
+
+import jax
+
+
+@jax.jit
+def debug_kernel(x):
+    # A knowingly-impure debug hook, declared as visible debt:
+    v = float(x)  # graftlint: disable=jit-purity
+    return x + v

@@ -1,0 +1,22 @@
+"""Golden negative for GL002 dtype-discipline: the exact-dtype idiom."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def kernel_exact(g, x):
+    prod = jnp.einsum(
+        "nv,mv->nm",
+        x.astype(jnp.int8),
+        x.astype(jnp.int8),
+        preferred_element_type=jnp.int32,
+    )
+    return g + prod.astype(g.dtype)
+
+
+def densify_exact(idx, n):
+    x = np.zeros((n, 8), dtype=np.int8)
+    x[idx, 0] = 1
+    return x

@@ -1,0 +1,22 @@
+"""Golden positive for GL002 dtype-discipline: float64 leaks into the
+integer-exact accumulation path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def accumulate_wrong(g, x):
+    xf = x.astype(np.float64)  # f64 reference
+    return g + xf @ xf.T
+
+
+def densify_wrong(idx, n):
+    x = np.zeros((n, 8), dtype=float)  # builtin float IS float64
+    x[idx, 0] = 1
+    return x.astype(float)  # and again on the way out
+
+
+@jax.jit
+def kernel_weak_promotion(g, x):
+    return g + (x * 0.5)  # float literal weak-type-promotes g

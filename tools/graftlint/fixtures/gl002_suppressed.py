@@ -1,0 +1,9 @@
+"""Golden pragma-suppressed case for GL002 dtype-discipline."""
+
+import numpy as np
+
+
+def host_f64_eig_input(g):
+    # The --precise host eigendecomposition legitimately runs f64 —
+    # outside the accumulation, declared as visible debt here:
+    return np.asarray(g, dtype=np.float64)  # graftlint: disable=dtype-discipline

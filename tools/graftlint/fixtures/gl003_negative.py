@@ -1,0 +1,19 @@
+"""Golden negative for GL003 span-contract: context-managed spans."""
+
+from spark_examples_tpu import obs
+from spark_examples_tpu.obs.tracer import get_tracer
+
+
+def timed_stage(tracer):
+    with tracer.span("stage", shard="s1"):
+        do_work()
+
+
+def timed_ambient():
+    with obs.span("ambient_stage"):
+        with get_tracer().span("nested"):
+            do_work()
+
+
+def do_work():
+    pass

@@ -1,0 +1,20 @@
+"""Golden positive for GL003 span-contract: bare (non-context-manager)
+span opens."""
+
+from spark_examples_tpu import obs
+from spark_examples_tpu.obs.tracer import get_tracer
+
+
+def leaky_stage(tracer):
+    s = tracer.span("stage")  # bare open: leaks on any exception path
+    do_work()
+    s.__exit__(None, None, None)
+
+
+def leaky_ambient():
+    handle = obs.span("ambient_stage")  # bare open again
+    return handle
+
+
+def do_work():
+    pass

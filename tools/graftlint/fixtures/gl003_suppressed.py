@@ -1,0 +1,13 @@
+"""Golden pragma-suppressed case for GL003 span-contract: a session
+root span whose open and close straddle a lifecycle boundary."""
+
+
+class Session:
+    def __enter__(self):
+        # Mirrors the surrounding object's lifecycle on purpose:
+        self._root = self.tracer.span("run")  # graftlint: disable=span-contract
+        self._root.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._root.__exit__(*exc)

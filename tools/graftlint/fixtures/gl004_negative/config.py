@@ -1,0 +1,13 @@
+"""GL004 negative: every flag binds a field and is documented."""
+
+import argparse
+from dataclasses import dataclass
+
+
+@dataclass
+class GenomicsConfig:
+    block_size: int = 8192
+
+
+def add_genomics_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--block-size", type=int, default=8192)

@@ -1,0 +1,10 @@
+"""GL004 negative CLI module: its one extra flag is read off args."""
+
+import argparse
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--api-url", default=None)
+    args = p.parse_args()
+    return args.api_url

@@ -1,0 +1,15 @@
+"""GL004 positive: flags, fields, and docs out of sync in every way."""
+
+import argparse
+from dataclasses import dataclass
+
+
+@dataclass
+class GenomicsConfig:
+    block_size: int = 8192
+    orphan_field: str = "x"  # no flag can set this
+
+
+def add_genomics_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--block-size", type=int, default=8192)
+    p.add_argument("--dead-flag", default=None)  # no field, never read

@@ -1,0 +1,10 @@
+"""GL004 positive CLI module: defines a flag it never reads."""
+
+import argparse
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--unused-cli-flag", default=None)
+    args = p.parse_args()
+    return args

@@ -1,0 +1,22 @@
+"""Golden negative for GL005 resilience-routing: the policy-routed
+attempt-function idiom the real transports use."""
+
+import time
+from urllib.request import urlopen
+
+from spark_examples_tpu.resilience import call_with_retry, classify_http, faults
+
+
+def fetch_routed(url, policy):
+    def attempt():
+        faults.inject("transport.http.request", key=url)
+        with urlopen(url) as resp:
+            return resp.read()
+
+    return call_with_retry(
+        attempt, policy, classify_http, transport="http", method="GET"
+    )
+
+
+def policy_paced_wait(policy, failures, budget):
+    time.sleep(min(policy.backoff_delay(failures), budget.remaining()))

@@ -1,0 +1,20 @@
+"""Golden positive for GL005 resilience-routing: bare retry sleeps and
+raw transport I/O outside any fault-seam-marked attempt function."""
+
+import time
+from urllib.request import urlopen
+
+
+def fetch_with_bare_retry(url):
+    for attempt in range(3):
+        try:
+            with urlopen(url) as resp:  # raw transport, no seam
+                return resp.read()
+        except OSError:
+            time.sleep(2**attempt)  # bare backoff, no policy
+    raise IOError(url)
+
+
+def raw_keepalive_roundtrip(conn, target):
+    conn.request("GET", target)  # raw transport, no seam
+    return conn.getresponse()  # and again

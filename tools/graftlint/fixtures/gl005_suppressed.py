@@ -1,0 +1,8 @@
+"""Golden pragma-suppressed case for GL005 resilience-routing."""
+
+import time
+
+
+def fixture_pacing_only(delay):
+    # Deterministic test-fixture pacing, not a retry backoff:
+    time.sleep(delay)  # graftlint: disable=resilience-routing

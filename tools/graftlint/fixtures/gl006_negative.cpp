@@ -1,0 +1,16 @@
+// Golden negative for GL006 native-gil: pure C++, raw pointers in and
+// out — the contract the real core holds. Mentions of Py_Anything in
+// comments or strings must not trip the rule:
+// e.g. "never call PyGILState_Ensure here".
+#include <cstdint>
+#include <cstring>
+
+static const char* kDoc = "pure C++: no PyObject anywhere";
+
+extern "C" int64_t scatter_bits(
+    const int64_t* idx, int64_t n, uint8_t* out, int64_t stride) {
+    for (int64_t i = 0; i < n; ++i) {
+        out[idx[i] * stride] |= 1;
+    }
+    return kDoc ? 0 : 1;
+}

@@ -1,0 +1,12 @@
+// Golden positive for GL006 native-gil: CPython touches in a core that
+// runs with the GIL released under ctypes.
+#include <Python.h>
+#include <cstdint>
+
+extern "C" int64_t count_calls(const int64_t* idx, int64_t n) {
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* list = PyList_New(0);
+    Py_DECREF(list);
+    PyGILState_Release(st);
+    return n;
+}

@@ -1,0 +1,8 @@
+// Golden pragma-suppressed case for GL006 native-gil.
+#include <cstdint>
+
+extern "C" int64_t with_declared_debt(int64_t n) {
+    // A hypothetical GIL-reacquiring region, declared as visible debt:
+    PyGILState_Ensure();  // graftlint: disable=native-gil
+    return n;
+}

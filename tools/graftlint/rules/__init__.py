@@ -1,0 +1,25 @@
+"""graftlint rule registry.
+
+A rule is an object with ``name`` (pragma id), ``code`` (stable GLxxx),
+``summary``, optional ``project_wide`` (cross-file contract rules run on
+their full configured scope regardless of CLI path narrowing), and
+``check(project) -> Iterable[Finding]``.
+"""
+
+from tools.graftlint.rules.dtype_discipline import RULE as DTYPE_DISCIPLINE
+from tools.graftlint.rules.flag_registry import RULE as FLAG_REGISTRY
+from tools.graftlint.rules.jit_purity import RULE as JIT_PURITY
+from tools.graftlint.rules.native_gil import RULE as NATIVE_GIL
+from tools.graftlint.rules.resilience_routing import RULE as RESILIENCE_ROUTING
+from tools.graftlint.rules.span_contract import RULE as SPAN_CONTRACT
+
+ALL_RULES = [
+    JIT_PURITY,
+    DTYPE_DISCIPLINE,
+    SPAN_CONTRACT,
+    FLAG_REGISTRY,
+    RESILIENCE_ROUTING,
+    NATIVE_GIL,
+]
+
+__all__ = ["ALL_RULES"]

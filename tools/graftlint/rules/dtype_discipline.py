@@ -1,0 +1,151 @@
+"""GL002 dtype-discipline: integer-exact Gramian path stays float64-free.
+
+The Gramian accumulation is *exact* arithmetic dressed as float matmul:
+0/1 indicator blocks ride the int8 MXU and the int32 counts are cast
+into an f32 accumulator, exact below 2^24 co-occurrences per pair
+(ops/gramian.py module docstring; the same integer-exact discipline the
+genotype-PCA kernels in Lange et al. arXiv:1808.03374 rely on). A
+float64 literal or an implicit weak-type promotion in this path is never
+a precision *upgrade* — on TPU f64 silently demotes or falls off the
+MXU, and a Python float scalar leaking into a jitted body weak-type-
+promotes the whole accumulator, changing the dtype the bit-identity
+tests pin.
+
+Flags, in the configured files (default: ops/gramian.py and
+arrays/blocks.py):
+
+- any ``float64`` reference (``np.float64``/``jnp.float64``/dtype
+  strings) and ``astype(float)``/``dtype=float`` (Python ``float`` IS
+  float64 as a dtype);
+- bare float literals inside jit-traced bodies (weak-type promotion of
+  the accumulator).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from tools.graftlint.astutil import jitted_functions
+from tools.graftlint.engine import Finding, Project
+
+NAME = "dtype-discipline"
+CODE = "GL002"
+
+DEFAULT_PATHS = (
+    "spark_examples_tpu/ops/gramian.py",
+    "spark_examples_tpu/arrays/blocks.py",
+)
+
+
+def _dtype_kwarg_is_builtin_float(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if (
+            kw.arg == "dtype"
+            and isinstance(kw.value, ast.Name)
+            and kw.value.id == "float"
+        ):
+            return True
+    return False
+
+
+def _astype_float(call: ast.Call) -> bool:
+    return (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr == "astype"
+        and len(call.args) == 1
+        and isinstance(call.args[0], ast.Name)
+        and call.args[0].id == "float"
+    )
+
+
+class DtypeDisciplineRule:
+    name = NAME
+    code = CODE
+    summary = (
+        "no float64 literals / builtin-float dtypes / weak-type float "
+        "promotion in the integer-exact Gramian accumulation path"
+    )
+    project_wide = False
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for top in project.rule_paths(NAME, DEFAULT_PATHS):
+            for rel in project.walk(top):
+                ctx = project.file(rel)
+                if ctx is None or ctx.tree is None:
+                    continue
+                jit_nodes: Set[ast.AST] = set()
+                for fn in jitted_functions(ctx.tree):
+                    jit_nodes.update(ast.walk(fn))
+                for node in ast.walk(ctx.tree):
+                    if (
+                        isinstance(node, ast.Attribute)
+                        and node.attr == "float64"
+                    ) or (
+                        isinstance(node, ast.Name)
+                        and node.id == "float64"
+                    ):
+                        findings.append(
+                            Finding(
+                                NAME,
+                                CODE,
+                                rel,
+                                node.lineno,
+                                "float64 in the integer-exact Gramian "
+                                "path: counts are exact in int32/f32 "
+                                "below 2^24; f64 is slower on the MXU "
+                                "and changes the pinned accumulator "
+                                "dtype",
+                            )
+                        )
+                    elif isinstance(node, ast.Constant) and isinstance(
+                        node.value, str
+                    ):
+                        if node.value == "float64" and node in jit_nodes:
+                            findings.append(
+                                Finding(
+                                    NAME,
+                                    CODE,
+                                    rel,
+                                    node.lineno,
+                                    "'float64' dtype string inside a "
+                                    "jit-traced Gramian body",
+                                )
+                            )
+                    elif isinstance(node, ast.Call):
+                        if _dtype_kwarg_is_builtin_float(
+                            node
+                        ) or _astype_float(node):
+                            findings.append(
+                                Finding(
+                                    NAME,
+                                    CODE,
+                                    rel,
+                                    node.lineno,
+                                    "builtin `float` as a dtype is "
+                                    "float64 — use an explicit exact "
+                                    "dtype (int8/int32/float32)",
+                                )
+                            )
+                    elif (
+                        isinstance(node, ast.Constant)
+                        and isinstance(node.value, float)
+                        and node in jit_nodes
+                    ):
+                        findings.append(
+                            Finding(
+                                NAME,
+                                CODE,
+                                rel,
+                                node.lineno,
+                                f"float literal {node.value!r} inside a "
+                                "jit-traced Gramian body weak-type-"
+                                "promotes the exact integer "
+                                "accumulation",
+                            )
+                        )
+        return findings
+
+
+RULE = DtypeDisciplineRule()

@@ -1,0 +1,257 @@
+"""GL004 flag-registry: CLI flags, config fields, and docs stay in sync.
+
+The flag surface is the operational API: a flag defined in
+``cli/main.py`` but absent from ``utils/config.py``'s dataclasses (and
+never read off ``args``) is dead weight; a dataclass field without a
+flag is unreachable config; and an undocumented flag — or documentation
+for a flag that no longer exists — is how operators end up cargo-culting
+invocations out of old logs. Three-way sync, checked statically:
+
+1. every ``add_argument("--flag")`` in the config/CLI modules must bind
+   to a config dataclass field OR be consumed (``args.<dest>`` /
+   ``getattr(args, "<dest>")``) in the CLI module;
+2. every config dataclass field must be settable by some flag;
+3. every defined flag must appear (as a ``--flag`` literal) in README
+   or docs/, and every ``--flag`` token in README/docs must be defined
+   by the CLI, the config module, or a script's argparse.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.graftlint.engine import Finding, Project
+
+NAME = "flag-registry"
+CODE = "GL004"
+
+DEFAULT_CONFIG_MODULE = "spark_examples_tpu/utils/config.py"
+DEFAULT_CLI_MODULE = "spark_examples_tpu/cli/main.py"
+DEFAULT_SCRIPT_PATHS = ("scripts", "tools")
+DEFAULT_DOC_PATHS = ("README.md", "docs")
+DEFAULT_CONFIG_CLASSES = ("GenomicsConfig", "PcaConfig")
+
+# A long-option token in prose: --flag, --flag-name. The lookarounds
+# reject --xla_force_... style env-flag prose (underscore continues the
+# token) and mid-word dashes.
+_DOC_FLAG = re.compile(r"(?<![\w-])--([a-z][a-z0-9]*(?:-[a-z0-9]+)*)(?![\w-])")
+
+
+def _add_argument_flags(
+    ctx,
+) -> List[Tuple[str, str, int, bool]]:
+    """(flag, dest, line, bool_optional) for every add_argument call
+    defining a long option."""
+    out: List[Tuple[str, str, int, bool]] = []
+    if ctx is None or ctx.tree is None:
+        return out
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_argument"
+        ):
+            continue
+        longs = [
+            a.value
+            for a in node.args
+            if isinstance(a, ast.Constant)
+            and isinstance(a.value, str)
+            and a.value.startswith("--")
+        ]
+        if not longs:
+            continue
+        dest = None
+        bool_optional = False
+        for kw in node.keywords:
+            if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+                dest = kw.value.value
+            if kw.arg == "action":
+                src = ast.unparse(kw.value)
+                bool_optional = "BooleanOptionalAction" in src
+        if dest is None:
+            dest = longs[0].lstrip("-").replace("-", "_")
+        for flag in longs:
+            out.append((flag, dest, node.lineno, bool_optional))
+    return out
+
+
+def _dataclass_fields(ctx, class_names: Iterable[str]) -> Set[str]:
+    fields: Set[str] = set()
+    if ctx is None or ctx.tree is None:
+        return fields
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef) and node.name in class_names:
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    fields.add(stmt.target.id)
+    return fields
+
+
+def _consumed_dests(ctx) -> Set[str]:
+    """Names read off an ``args`` namespace in the CLI module."""
+    used: Set[str] = set()
+    if ctx is None or ctx.tree is None:
+        return used
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "args"
+        ):
+            used.add(node.attr)
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "getattr"
+            and len(node.args) >= 2
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id == "args"
+            and isinstance(node.args[1], ast.Constant)
+        ):
+            used.add(node.args[1].value)
+    return used
+
+
+class FlagRegistryRule:
+    name = NAME
+    code = CODE
+    summary = (
+        "CLI flags <-> config dataclass fields <-> README/docs entries "
+        "stay a closed, synchronized registry"
+    )
+    project_wide = True
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        cfg = project.config.get("rules", {}).get(NAME, {})
+        config_module = cfg.get("config_module", DEFAULT_CONFIG_MODULE)
+        cli_module = cfg.get("cli_module", DEFAULT_CLI_MODULE)
+        script_paths = cfg.get("script_paths", list(DEFAULT_SCRIPT_PATHS))
+        doc_paths = cfg.get("doc_paths", list(DEFAULT_DOC_PATHS))
+        config_classes = cfg.get(
+            "config_classes", list(DEFAULT_CONFIG_CLASSES)
+        )
+        doc_ignore = set(cfg.get("doc_ignore", ()))
+
+        findings: List[Finding] = []
+        config_ctx = project.file(config_module)
+        cli_ctx = project.file(cli_module)
+        config_flags = _add_argument_flags(config_ctx)
+        cli_flags = _add_argument_flags(cli_ctx)
+        fields = _dataclass_fields(config_ctx, config_classes)
+        consumed = _consumed_dests(cli_ctx)
+
+        defined: Dict[str, Tuple[str, int]] = {}
+        for flags, rel in (
+            (config_flags, config_module),
+            (cli_flags, cli_module),
+        ):
+            for flag, dest, line, bool_optional in flags:
+                defined[flag] = (rel, line)
+                if bool_optional:
+                    defined["--no-" + flag[2:]] = (rel, line)
+
+        # 1. Defined flag -> config field or CLI consumption.
+        for flags, rel in (
+            (config_flags, config_module),
+            (cli_flags, cli_module),
+        ):
+            for flag, dest, line, _ in flags:
+                if dest not in fields and dest not in consumed:
+                    findings.append(
+                        Finding(
+                            NAME,
+                            CODE,
+                            rel,
+                            line,
+                            f"flag {flag} (dest {dest!r}) binds to no "
+                            "config dataclass field and is never read "
+                            "off args in the CLI — dead flag",
+                        )
+                    )
+
+        # 2. Config field -> some flag's dest.
+        dests = {d for flags in (config_flags, cli_flags) for _, d, _, _ in flags}
+        for field_name in sorted(fields - dests):
+            findings.append(
+                Finding(
+                    NAME,
+                    CODE,
+                    config_module,
+                    _line_of(config_ctx, field_name),
+                    f"config field {field_name!r} has no CLI flag — "
+                    "unreachable configuration",
+                )
+            )
+
+        # Gather script-defined flags (validate_trace etc.) for the
+        # docs->defined direction only.
+        script_defined: Set[str] = set()
+        for top in script_paths:
+            for rel in project.walk(top):
+                for flag, _, _, bool_optional in _add_argument_flags(
+                    project.file(rel)
+                ):
+                    script_defined.add(flag)
+                    if bool_optional:
+                        script_defined.add("--no-" + flag[2:])
+
+        # 3a. Defined flag (config/CLI surface) -> documented.
+        doc_tokens: Dict[str, Tuple[str, int]] = {}
+        for top in doc_paths:
+            for rel in project.walk(top, suffixes=(".md",)):
+                ctx = project.file(rel)
+                if ctx is None:
+                    continue
+                for lineno, line in enumerate(ctx.lines, 1):
+                    for m in _DOC_FLAG.finditer(line):
+                        doc_tokens.setdefault(
+                            "--" + m.group(1), (rel, lineno)
+                        )
+        for flag in sorted(defined):
+            if flag not in doc_tokens and flag not in doc_ignore:
+                rel, line = defined[flag]
+                findings.append(
+                    Finding(
+                        NAME,
+                        CODE,
+                        rel,
+                        line,
+                        f"flag {flag} is documented nowhere in "
+                        "README.md or docs/ — undocumented operational "
+                        "surface",
+                    )
+                )
+
+        # 3b. Documented flag -> defined somewhere real.
+        all_defined = set(defined) | script_defined
+        for flag in sorted(doc_tokens):
+            if flag not in all_defined and flag not in doc_ignore:
+                rel, line = doc_tokens[flag]
+                findings.append(
+                    Finding(
+                        NAME,
+                        CODE,
+                        rel,
+                        line,
+                        f"documented flag {flag} is defined by no "
+                        "argparse surface (CLI, config, scripts) — "
+                        "stale documentation",
+                    )
+                )
+        return findings
+
+
+def _line_of(ctx, needle: str) -> int:
+    if ctx is not None:
+        for lineno, line in enumerate(ctx.lines, 1):
+            if needle in line:
+                return lineno
+    return 1
+
+
+RULE = FlagRegistryRule()

@@ -1,0 +1,133 @@
+"""GL001 jit-purity: no host syncs or Python side effects inside jit.
+
+A ``@jax.jit``/``pjit`` body is a *traced program*: anything that pulls a
+traced value back to the host (``jax.device_get``, ``float(x)``,
+``np.asarray(x)``, ``.block_until_ready()``, ``.item()``) either crashes
+under tracing or — worse — silently forces a device sync on every call,
+the exact silent-host-sync rot the streaming-feed literature warns
+overlap pipelines about. Python side effects (prints, tracer spans,
+metric increments) run once at trace time and then never again, so they
+lie: a span inside jit times the *trace*, not the execution.
+
+The dynamic contract this front-runs: the transfer/compute overlap that
+PR 3-4 measured (double-buffered feed, completion-order ingest) only
+holds while the accumulation kernels stay dispatch-async; one stray
+host sync serializes the pipeline and no tier-1 test asserts wall-clock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tools.graftlint.astutil import (
+    call_name,
+    jitted_functions,
+    walk_calls,
+)
+from tools.graftlint.engine import Finding, Project
+
+NAME = "jit-purity"
+CODE = "GL001"
+
+DEFAULT_PATHS = (
+    "spark_examples_tpu/ops",
+    "spark_examples_tpu/parallel",
+    "spark_examples_tpu/arrays/feed.py",
+)
+
+# Callee dotted-name suffixes that mean "host sync" inside a trace.
+_HOST_SYNC_SUFFIXES = (
+    "device_get",
+    "block_until_ready",
+    "item",
+    "tolist",
+)
+# numpy host-materialization entry points (np.prod over a static shape
+# is fine and common; materializing an *array* is not).
+_NUMPY_MATERIALIZE = ("asarray", "array", "copyto", "save", "frombuffer")
+# Telemetry/obs surfaces: side effects that run at trace time only.
+_SIDE_EFFECT_SUFFIXES = (
+    "span",
+    "instant",
+    "get_registry",
+    "observe_rpc",
+    "count_retry",
+    "rpc_timer",
+    "inc",
+    "observe",
+)
+
+
+def _violation(call: ast.Call) -> str:
+    name = call_name(call) or ""
+    last = name.rsplit(".", 1)[-1]
+    root = name.split(".", 1)[0]
+    if last in _HOST_SYNC_SUFFIXES:
+        return (
+            f"host sync `{name}(...)` inside a jit-traced body: forces a "
+            "device round-trip (or crashes under tracing)"
+        )
+    if root in ("np", "numpy") and last in _NUMPY_MATERIALIZE:
+        return (
+            f"`{name}(...)` inside a jit-traced body materializes on "
+            "host — a silent per-call device sync"
+        )
+    if last == "print" or name == "print":
+        return (
+            "print inside a jit-traced body runs at trace time only "
+            "(use jax.debug.print for runtime prints)"
+        )
+    if last in _SIDE_EFFECT_SUFFIXES or root == "obs":
+        return (
+            f"telemetry side effect `{name}(...)` inside a jit-traced "
+            "body fires once at trace time, then never again — it times "
+            "the trace, not the execution"
+        )
+    return ""
+
+
+class JitPurityRule:
+    name = NAME
+    code = CODE
+    summary = (
+        "no host syncs (device_get/float()/np.asarray/.item) or Python "
+        "side effects (print, spans, metrics) inside @jax.jit/pjit bodies"
+    )
+    project_wide = False
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for top in project.rule_paths(NAME, DEFAULT_PATHS):
+            for rel in project.walk(top):
+                ctx = project.file(rel)
+                if ctx is None or ctx.tree is None:
+                    continue
+                for fn in jitted_functions(ctx.tree):
+                    for call in walk_calls(fn):
+                        msg = _violation(call)
+                        if not msg:
+                            # float(x) on a non-constant: the classic
+                            # implicit device_get.
+                            cname = call_name(call)
+                            if (
+                                cname == "float"
+                                and len(call.args) == 1
+                                and not isinstance(
+                                    call.args[0], ast.Constant
+                                )
+                            ):
+                                msg = (
+                                    "float(...) on a traced value is an "
+                                    "implicit device_get inside jit"
+                                )
+                        if msg:
+                            findings.append(
+                                Finding(
+                                    NAME, CODE, rel, call.lineno, msg
+                                )
+                            )
+        return findings
+
+
+RULE = JitPurityRule()

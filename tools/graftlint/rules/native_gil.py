@@ -1,0 +1,123 @@
+"""GL006 native-gil: the GIL-released native core never touches CPython.
+
+``native/genomics_native.cpp`` is loaded with ``ctypes.CDLL`` and every
+exported function runs with the GIL **released** (ctypes drops it for
+the duration of the foreign call — that is exactly why the multi-worker
+block builders scale). Touching the Python C-API from such a region
+(``PyObject``, ``PyGILState_*``, ``Py_*`` anything, or including
+``Python.h`` at all) is undefined behavior unless the GIL is explicitly
+re-acquired — a crash that only reproduces under thread pressure, the
+worst kind. The native core is therefore *pure C++ by contract*: arrays
+in, arrays out, via raw pointers. This rule scans the source (comments
+and string literals stripped) and flags any CPython identifier.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, List
+
+from tools.graftlint.engine import Finding, Project
+
+NAME = "native-gil"
+CODE = "GL006"
+
+DEFAULT_PATHS = ("spark_examples_tpu/native",)
+
+_CAPI = re.compile(r"\bPy[A-Z_][A-Za-z0-9_]*|\bPython\.h\b")
+
+
+def strip_comments_and_strings(src: str) -> str:
+    """Blank out //, /* */ comments and "..."/'...' literals, keeping
+    line structure so findings carry real line numbers."""
+    out: List[str] = []
+    i, n = 0, len(src)
+    mode = None  # None | "line" | "block" | '"' | "'"
+    while i < n:
+        c = src[i]
+        nxt = src[i + 1] if i + 1 < n else ""
+        if mode is None:
+            if c == "/" and nxt == "/":
+                mode = "line"
+                out.append("  ")
+                i += 2
+                continue
+            if c == "/" and nxt == "*":
+                mode = "block"
+                out.append("  ")
+                i += 2
+                continue
+            if c in ('"', "'"):
+                mode = c
+                out.append(" ")
+                i += 1
+                continue
+            out.append(c)
+        else:
+            if c == "\n":
+                out.append("\n")
+                if mode == "line":
+                    mode = None
+                i += 1
+                continue
+            if mode == "block" and c == "*" and nxt == "/":
+                mode = None
+                out.append("  ")
+                i += 2
+                continue
+            if mode in ('"', "'") and c == "\\":
+                # Preserve an escaped newline: blanking it would merge
+                # two source lines and shift every later finding (and
+                # pragma lookup) off by one.
+                out.append(" \n" if nxt == "\n" else "  ")
+                i += 2
+                continue
+            if mode in ('"', "'") and c == mode:
+                mode = None
+            out.append(" ")
+        i += 1
+    return "".join(out)
+
+
+class NativeGilRule:
+    name = NAME
+    code = CODE
+    summary = (
+        "the ctypes-loaded (GIL-released) native core stays pure C++: "
+        "no Python C-API identifiers, no Python.h"
+    )
+    project_wide = False
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for top in project.rule_paths(NAME, DEFAULT_PATHS):
+            for rel in project.walk(
+                top, suffixes=(".cpp", ".cc", ".h", ".hpp")
+            ):
+                ctx = project.file(rel)
+                if ctx is None:
+                    continue
+                stripped = strip_comments_and_strings(ctx.text)
+                for lineno, line in enumerate(
+                    stripped.splitlines(), 1
+                ):
+                    for m in _CAPI.finditer(line):
+                        findings.append(
+                            Finding(
+                                NAME,
+                                CODE,
+                                rel,
+                                lineno,
+                                f"Python C-API touch {m.group(0)!r} in "
+                                "a GIL-released region: every export "
+                                "here runs under ctypes with the GIL "
+                                "dropped — CPython calls are undefined "
+                                "behavior unless PyGILState is "
+                                "re-acquired, and this core is pure-"
+                                "C++ by contract",
+                            )
+                        )
+        return findings
+
+
+RULE = NativeGilRule()

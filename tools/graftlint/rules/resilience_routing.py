@@ -1,0 +1,155 @@
+"""GL005 resilience-routing: genomics transport I/O rides the policy engine.
+
+PR 2-3 centralized every retry/backoff/deadline/breaker decision into
+``spark_examples_tpu.resilience`` — and taught every transport seam to
+carry a ``faults.inject("transport...")`` marker so the deterministic
+fault plane can reach it. The contract rots one convenience call at a
+time: a quick ``time.sleep(1)`` before a retry, a bare ``urlopen`` in a
+new helper. Each bypasses classification (retryable vs served error),
+the breaker, the deadline budget, the retry metrics, AND the fault
+seams the chaos suite drives. Statically enforced instead:
+
+- ``time.sleep`` in ``genomics/`` must compute its delay from the
+  policy engine (``backoff_delay``/``remaining``/``retry_after`` in the
+  argument expression) — anything else is a bare retry sleep;
+- raw transport primitives (``urlopen``, connection ``.request`` /
+  ``.getresponse``, ``socket.create_connection``) may only appear
+  inside a function that carries a ``faults.inject("transport...")``
+  seam — the marker every policy-routed attempt function in the tree
+  already carries (service._one_attempt, oauth's attempt, the gRPC
+  request seams).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from tools.graftlint.astutil import call_name, literal_str
+from tools.graftlint.engine import Finding, Project
+
+NAME = "resilience-routing"
+CODE = "GL005"
+
+DEFAULT_PATHS = ("spark_examples_tpu/genomics",)
+
+# Identifiers that mark a sleep as policy-derived.
+_POLICY_DELAY_MARKERS = ("backoff_delay", "remaining", "retry_after")
+
+
+def _sleep_is_policy_routed(call: ast.Call) -> bool:
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Attribute) and sub.attr in (
+                _POLICY_DELAY_MARKERS
+            ):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in (
+                _POLICY_DELAY_MARKERS
+            ):
+                return True
+    return False
+
+
+def _is_raw_transport_call(call: ast.Call) -> Optional[str]:
+    cname = call_name(call) or ""
+    last = cname.rsplit(".", 1)[-1]
+    if last == "urlopen" or cname == "urlopen":
+        return "urlopen"
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        if attr == "getresponse":
+            return ".getresponse()"
+        if attr == "request" and not (
+            isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "self"
+        ):
+            return ".request()"
+        if attr == "create_connection":
+            return "socket.create_connection"
+    return None
+
+
+def _has_transport_seam(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = call_name(node) or ""
+        if cname.rsplit(".", 1)[-1] != "inject":
+            continue
+        site = literal_str(node.args[0]) if node.args else None
+        if site is not None and site.startswith("transport."):
+            return True
+    return False
+
+
+class ResilienceRoutingRule:
+    name = NAME
+    code = CODE
+    summary = (
+        "genomics/ transport calls route through the resilience policy "
+        "engine: no bare sleeps, raw I/O only inside fault-seam-marked "
+        "attempt functions"
+    )
+    project_wide = False
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for top in project.rule_paths(NAME, DEFAULT_PATHS):
+            for rel in project.walk(top):
+                ctx = project.file(rel)
+                if ctx is None or ctx.tree is None:
+                    continue
+                # Map every node to its innermost enclosing functions.
+                enclosing = {}
+                for fn in ast.walk(ctx.tree):
+                    if isinstance(
+                        fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        for sub in ast.walk(fn):
+                            enclosing.setdefault(id(sub), []).append(fn)
+                for node in ast.walk(ctx.tree):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    cname = call_name(node) or ""
+                    if cname.rsplit(".", 1)[-1] == "sleep":
+                        if not _sleep_is_policy_routed(node):
+                            findings.append(
+                                Finding(
+                                    NAME,
+                                    CODE,
+                                    rel,
+                                    node.lineno,
+                                    "bare sleep in genomics/: backoff "
+                                    "must come from the resilience "
+                                    "policy engine (RetryPolicy."
+                                    "backoff_delay / deadline budget / "
+                                    "Retry-After), which this delay "
+                                    "expression does not reference",
+                                )
+                            )
+                        continue
+                    prim = _is_raw_transport_call(node)
+                    if prim is None:
+                        continue
+                    fns = enclosing.get(id(node), [])
+                    if not any(_has_transport_seam(fn) for fn in fns):
+                        findings.append(
+                            Finding(
+                                NAME,
+                                CODE,
+                                rel,
+                                node.lineno,
+                                f"raw transport call {prim} outside a "
+                                "fault-seam-marked attempt function: "
+                                "wrap it in a function carrying "
+                                "faults.inject('transport...') and "
+                                "route it through call_with_retry so "
+                                "classification, breaker, deadline, "
+                                "and chaos seams all apply",
+                            )
+                        )
+        return findings
+
+
+RULE = ResilienceRoutingRule()
