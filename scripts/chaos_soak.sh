@@ -5,20 +5,52 @@
 # numerically identical to the fault-free run — plus a clean resume
 # over whatever checkpoint residue each plan left behind.
 #
+# Since round 6 the soak has a SECOND leg: the service-mode soak drives
+# the --analyze job tier end to end over a real subprocess server —
+# submit / kill -9 / restart / resume — and requires the resumed job's
+# coordinates bit-identical to the uninterrupted run
+# (tests/test_serving.py::TestServiceChaosSoak).
+#
 # Usage:
-#   scripts/chaos_soak.sh                 # default CHAOS_SOAK_ITERS=5
+#   scripts/chaos_soak.sh                 # CHAOS_SOAK_ITERS=5, SERVICE_SOAK_ITERS=2
 #   CHAOS_SOAK_ITERS=25 scripts/chaos_soak.sh
+#   SERVICE_SOAK_ITERS=10 scripts/chaos_soak.sh
 #   scripts/chaos_soak.sh -k randomized   # extra pytest args pass through
 #
-# The deterministic resilience suite (tier-1) lives in the same file and
-# runs on every CI pass; this entry point is the long-running fuzz loop
-# (marked `slow`, excluded from tier-1). See docs/RESILIENCE.md.
+# The deterministic resilience + serving suites (tier-1) live in the
+# same files and run on every CI pass; this entry point is the
+# long-running fuzz loop (marked `slow`, excluded from tier-1). See
+# docs/RESILIENCE.md.
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 : "${CHAOS_SOAK_ITERS:=5}"
+: "${SERVICE_SOAK_ITERS:=2}"
 
-exec env JAX_PLATFORMS=cpu CHAOS_SOAK_ITERS="$CHAOS_SOAK_ITERS" \
-    python -m pytest tests/test_resilience.py -q -m slow \
-    -p no:cacheprovider "$@"
+# Each leg tolerates pytest exit 5 ("no tests matched") so a -k filter
+# aimed at one leg doesn't fail the other — but BOTH matching nothing
+# is still an error (a typo'd filter must not go green).
+ran=0
+
+run_leg() {
+    local rc=0
+    env JAX_PLATFORMS=cpu \
+        CHAOS_SOAK_ITERS="$CHAOS_SOAK_ITERS" \
+        SERVICE_SOAK_ITERS="$SERVICE_SOAK_ITERS" \
+        python -m pytest "$1" -q -m slow -p no:cacheprovider \
+        "${@:2}" || rc=$?
+    if [ "$rc" = 5 ]; then
+        return 0
+    fi
+    [ "$rc" = 0 ] && ran=1
+    return "$rc"
+}
+
+run_leg tests/test_resilience.py "$@"
+run_leg tests/test_serving.py "$@"
+
+if [ "$ran" = 0 ]; then
+    echo "chaos_soak: no tests matched in either leg" >&2
+    exit 5
+fi

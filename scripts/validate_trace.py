@@ -50,6 +50,15 @@ _INGEST_SPANS = {
     "ingest.put",    # device staging inside the prefetch feed
 }
 
+# Analysis job tier span contract (serving/): every `job.<sub>` span
+# must be one of these — same closed-set discipline as the ingest
+# sub-phases, so a renamed job span can never silently vanish from the
+# timeline the service's state-transition story depends on.
+_JOB_SPANS = {
+    "job.run",     # one job's execution (ingest -> gramian -> pca)
+    "job.replay",  # crash-recovery journal replay at tier startup
+}
+
 # Prometheus exposition line shapes (text format 0.0.4).
 _PROM_COMMENT = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ?.*$")
 _PROM_SAMPLE = re.compile(
@@ -109,6 +118,14 @@ def validate_trace(path: str) -> List[str]:
                 f"{ev['name']!r} (expected one of "
                 f"{sorted(_INGEST_SPANS)})"
             )
+        elif (
+            ev["name"].startswith("job.")
+            and ev["name"] not in _JOB_SPANS
+        ):
+            errors.append(
+                f"{where}: unknown job-tier span {ev['name']!r} "
+                f"(expected one of {sorted(_JOB_SPANS)})"
+            )
         if not isinstance(ev.get("pid"), int):
             errors.append(f"{where}: pid must be an int")
         if ph != "M":
@@ -139,6 +156,16 @@ _WIRE_HISTOGRAM = "wire_frame_decode_seconds"
 _INGEST_COUNTERS = ("ingest_blocks_built_total",)
 _INGEST_HISTOGRAM = "ingest_block_build_seconds"
 
+# Serving/resilience metric contract: each of these counters must carry
+# the named label on every sample (and GL003 statically requires the
+# registration sites to chain it). Checked only when present, like the
+# wire/ingest metrics.
+_LABELED_COUNTERS = {
+    "breaker_probe_total": "outcome",     # half-open probe outcomes
+    "serving_jobs_total": "outcome",      # done/failed/cached/deduped
+    "serving_shed_total": "reason",       # queue_full/quota
+}
+
 
 def _check_wire_metrics(path: str, sample_lines: List[str]) -> List[str]:
     errors: List[str] = []
@@ -157,6 +184,12 @@ def _check_wire_metrics(path: str, sample_lines: List[str]) -> List[str]:
         ) and 'mode="' not in line:
             errors.append(
                 f"{path}: {name} sample missing its mode label: {line!r}"
+            )
+        required = _LABELED_COUNTERS.get(name)
+        if required is not None and f'{required}="' not in line:
+            errors.append(
+                f"{path}: {name} sample missing its {required} label: "
+                f"{line!r}"
             )
     for hist in (_WIRE_HISTOGRAM, _INGEST_HISTOGRAM):
         if f"{hist}_bucket" in names:

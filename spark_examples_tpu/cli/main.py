@@ -18,6 +18,7 @@ from spark_examples_tpu.genomics.fixtures import (
 )
 from spark_examples_tpu.genomics.sources import JsonlSource
 from spark_examples_tpu.utils.config import (
+    add_analyze_flags,
     add_pca_flags,
     pca_config_from_args,
 )
@@ -360,6 +361,51 @@ def _cmd_pca_bridge(args) -> int:
     return 0
 
 
+def _analysis_tier(args, source):
+    """The --analyze job tier: re-entrant PCA engine over the served
+    source + bounded admission + crash-safe journal (serving/)."""
+    from spark_examples_tpu.serving import AnalysisEngine, AnalysisJobTier
+
+    # Loud validation before any work, like every other flag surface
+    # (--prefetch-depth/--ingest-workers discipline): a zero-worker
+    # tier would accept jobs and never run them.
+    for flag, value in (
+        ("--analyze-workers", args.analyze_workers),
+        ("--analyze-queue-depth", args.analyze_queue_depth),
+        ("--analyze-tenant-quota", args.analyze_tenant_quota),
+        ("--analyze-cache-size", args.analyze_cache_size),
+    ):
+        if value < 1:
+            raise SystemExit(f"{flag} must be >= 1, got {value}")
+    # Jobs jit-compile on demand; the persistent cache means job #1
+    # after a restart pays no recompile either.
+    _enable_compile_cache()
+    mesh = None
+    if args.mesh_shape:
+        from spark_examples_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(args.mesh_shape)
+    base = pca_config_from_args(args)
+    if not args.variant_set_ids:
+        base.variant_set_ids = [DEFAULT_VARIANT_SET_ID]
+    if not args.analyze_journal_dir:
+        print(
+            "WARNING: --analyze without --analyze-journal-dir: jobs are "
+            "in-memory only and a crash forgets them all.",
+            file=sys.stderr,
+        )
+    tier = AnalysisJobTier(
+        AnalysisEngine(source, mesh=mesh),
+        base,
+        queue_depth=args.analyze_queue_depth,
+        tenant_quota=args.analyze_tenant_quota,
+        workers=args.analyze_workers,
+        journal_dir=args.analyze_journal_dir,
+        cache_size=args.analyze_cache_size,
+    )
+    return tier.start()
+
+
 def _cmd_serve_cohort(args) -> int:
     """Host a cohort as a Genomics-compatible HTTP service."""
     from spark_examples_tpu.genomics.service import GenomicsServiceServer
@@ -418,9 +464,28 @@ def _cmd_serve_cohort(args) -> int:
             + (" (token auth)" if args.token else ""),
             flush=True,
         )
+    job_tier = None
     try:
+        if args.analyze:
+            job_tier = _analysis_tier(args, source)
+            print(
+                f"Analysis tier up: queue depth "
+                f"{args.analyze_queue_depth}, tenant quota "
+                f"{args.analyze_tenant_quota}, "
+                f"{args.analyze_workers} worker(s)"
+                + (
+                    f", journal {args.analyze_journal_dir}"
+                    if args.analyze_journal_dir
+                    else " (no journal)"
+                ),
+                flush=True,
+            )
         server = GenomicsServiceServer(
-            source, port=args.port, token=args.token, host=args.host
+            source,
+            port=args.port,
+            token=args.token,
+            host=args.host,
+            job_tier=job_tier,
         )
         print(
             f"Genomics service listening on http://{args.host}:{server.port}"
@@ -432,10 +497,12 @@ def _cmd_serve_cohort(args) -> int:
         except KeyboardInterrupt:
             server.stop()
     finally:
-        # Covers HTTP bind failures too — a started gRPC server must
-        # never outlive the command that printed its URL.
+        # Covers HTTP bind failures too — a started gRPC server or job
+        # tier must never outlive the command that printed its URL.
         if grpc_server is not None:
             grpc_server.stop()
+        if job_tier is not None:
+            job_tier.close()
     return 0
 
 
@@ -527,6 +594,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="Host a cohort as a Genomics-compatible HTTP service",
     )
     add_pca_flags(serve)
+    add_analyze_flags(serve)
     _add_fixture_flags(serve)
     serve.add_argument("--port", type=int, default=18718)
     serve.add_argument(

@@ -57,6 +57,11 @@ __all__ = ["GenomicsServiceServer", "HttpVariantSource"]
 _DATA_PREFIX = b"d "
 _END_FRAME = b"e"
 
+# POST body ceiling: an /analyze spec is a few hundred bytes; anything
+# megabyte-sized is a broken client or an attacker, and buffering it
+# would convert an unauthenticated request into server memory.
+_MAX_POST_BODY = 1 << 20
+
 
 class _ServedHttpError(Exception):
     """Carrier for a served HTTP error status (the urllib.HTTPError
@@ -108,12 +113,142 @@ def _decoded_lines(resp) -> Iterator[bytes]:
         yield buf
 
 
-def _make_handler(source, token: Optional[str]):
+def _make_handler(source, token: Optional[str], job_tier=None):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
         def log_message(self, *args):  # quiet: tests run many requests
             pass
+
+        def _send_json(
+            self,
+            code: int,
+            doc: dict,
+            retry_after: Optional[float] = None,
+        ) -> None:
+            body = (json.dumps(doc) + "\n").encode()
+            self.send_response(code)
+            if retry_after is not None:
+                # Integer delta-seconds (RFC 9110), never below 1 — a
+                # Retry-After of 0 invites an immediate hammer.
+                self.send_header(
+                    "Retry-After", str(max(1, int(-(-retry_after // 1))))
+                )
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _handle_jobs_get(self, path: str) -> None:
+            # The job tier's read surface: /jobs lists, /jobs/<id>
+            # fetches one (result rows included when done).
+            if path == "/jobs":
+                self._send_json(
+                    200,
+                    {
+                        "jobs": [
+                            j.to_record(include_result=False)
+                            for j in job_tier.jobs()
+                        ],
+                        "queue_depth": job_tier.queue_depth(),
+                    },
+                )
+                return
+            job = job_tier.job(path[len("/jobs/"):])
+            if job is None:
+                self.send_error(404, "no such job")
+                return
+            self._send_json(200, job.to_record())
+
+        def do_POST(self):  # noqa: N802 — http.server API
+            # Drain the body FIRST, whatever the outcome: unread body
+            # bytes left on a keep-alive socket are parsed as the next
+            # request line and poison the connection. The body length
+            # must be KNOWN: chunked framing would be misread as zero
+            # bytes — silently running the default analysis instead of
+            # the client's spec — with the chunk bytes left to poison
+            # the socket.
+            if self.headers.get("Transfer-Encoding"):
+                self._send_json(
+                    501,
+                    {
+                        "error": "chunked request bodies are not "
+                        "supported; send Content-Length"
+                    },
+                )
+                self.close_connection = True
+                return
+            if "Content-Length" not in self.headers:
+                self._send_json(
+                    411, {"error": "Content-Length required"}
+                )
+                self.close_connection = True  # body may be in flight
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                self._send_json(
+                    400, {"error": "malformed Content-Length header"}
+                )
+                self.close_connection = True  # body length unknowable
+                return
+            if length > _MAX_POST_BODY:
+                # Refuse BEFORE buffering: the bound must hold for
+                # unauthenticated requests too, or body size becomes
+                # an unauthenticated memory lever.
+                self._send_json(
+                    413,
+                    {
+                        "error": "request body too large "
+                        f"(> {_MAX_POST_BODY} bytes)"
+                    },
+                )
+                self.close_connection = True  # body left unread
+                return
+            body = self.rfile.read(length) if length > 0 else b""
+            if not self._authorized():
+                self._deny()
+                return
+            url = urlparse(self.path)
+            if url.path != "/analyze" or job_tier is None:
+                self.send_error(
+                    404,
+                    "no analysis tier here"
+                    if job_tier is None
+                    else "unknown endpoint",
+                )
+                return
+            from spark_examples_tpu.resilience import CircuitOpenError
+            from spark_examples_tpu.serving import AdmissionError, JobSpec
+
+            try:
+                spec = JobSpec.from_record(json.loads(body or b"{}"))
+            except (ValueError, TypeError) as e:
+                self._send_json(400, {"error": str(e)})
+                return
+            try:
+                job, created = job_tier.submit(spec)
+            except AdmissionError as e:
+                # Explicit load shedding: bounded queue / tenant quota.
+                # Retry-After derives from RetryPolicy.backoff_delay
+                # over the shed streak (serving/queue.py) — the same
+                # backoff engine the client's retry loop honors.
+                self._send_json(
+                    429,
+                    {"error": str(e), "reason": e.reason},
+                    retry_after=e.retry_after,
+                )
+                return
+            except CircuitOpenError as e:
+                # The analyze breaker is open (job executions are
+                # failing IO-shaped): shed until the next probe window.
+                self._send_json(
+                    503,
+                    {"error": str(e), "reason": "breaker_open"},
+                    retry_after=e.retry_in,
+                )
+                return
+            self._send_json(202 if created else 200, job.to_record())
 
         def _authorized(self) -> bool:
             if token is None:
@@ -366,6 +501,10 @@ def _make_handler(source, token: Optional[str]):
                                 break
                             self.wfile.write(chunk)
                             remaining -= len(chunk)
+                elif (
+                    url.path == "/jobs" or url.path.startswith("/jobs/")
+                ) and job_tier is not None:
+                    self._handle_jobs_get(url.path)
                 elif url.path.startswith("/export/"):
                     # Whole-cohort interchange-file export, framed and
                     # gzip-able like every stream: the bulk path remote
@@ -401,9 +540,10 @@ class GenomicsServiceServer:
         port: int = 0,
         token: Optional[str] = None,
         host: str = "127.0.0.1",
+        job_tier=None,
     ):
         self._srv = ThreadingHTTPServer(
-            (host, port), _make_handler(source, token)
+            (host, port), _make_handler(source, token, job_tier)
         )
         self._srv.daemon_threads = True
         self._thread: Optional[threading.Thread] = None

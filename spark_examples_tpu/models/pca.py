@@ -62,7 +62,7 @@ def _contig_runs_unique(shards) -> bool:
 
 
 class VariantsPcaDriver:
-    def __init__(self, conf: PcaConfig, source, mesh=None):
+    def __init__(self, conf: PcaConfig, source, mesh=None, index=None):
         if conf.num_pc < 1:
             # Validate before any ingest work — failing in stage 5 would
             # waste the whole (potentially hours-long) Gramian pass.
@@ -120,7 +120,15 @@ class VariantsPcaDriver:
         self.conf = conf
         self.source = source
         self.mesh = mesh
-        self.index = CallsetIndex.from_source(source, conf.variant_set_ids)
+        # A pre-built index makes the driver cheap to construct per job:
+        # the serving engine (serving/engine.py) shares ONE immutable
+        # CallsetIndex across concurrent jobs over the same cohort
+        # instead of re-listing callsets per submission.
+        self.index = (
+            index
+            if index is not None
+            else CallsetIndex.from_source(source, conf.variant_set_ids)
+        )
         self._pin_g_jit = None  # compiled-once G-resharding (pod snapshots)
         self._speculated_shards = 0  # straggler duplicates launched
 
@@ -1422,12 +1430,14 @@ class VariantsPcaDriver:
 
     # -- stage 6: emission ---------------------------------------------------
 
-    def emit_result(self, result: Sequence[Tuple[str, float, float]]) -> None:
-        from spark_examples_tpu.parallel.distributed import is_coordinator
-
-        if not is_coordinator():
-            return  # coordinator-only emission (the driver role)
-        with_names = [
+    def collect_result(
+        self, result: Sequence[Tuple[str, float, float]]
+    ) -> List[Tuple[str, float, float, str]]:
+        """``emitResult``'s row shape — ``(name, pc1, pc2, dataset)``
+        sorted by name — WITHOUT the emission side effects: the return
+        surface the serving tier (serving/engine.py) hands back to
+        clients, and the one place the name/dataset join lives."""
+        return sorted(
             (
                 self.index.names[cid],
                 pc1,
@@ -1435,14 +1445,21 @@ class VariantsPcaDriver:
                 cid.split("-")[0],  # dataset label, VariantsPca.scala:235
             )
             for cid, pc1, pc2 in result
-        ]
-        for name, pc1, pc2, dataset in sorted(with_names):
+        )
+
+    def emit_result(self, result: Sequence[Tuple[str, float, float]]) -> None:
+        from spark_examples_tpu.parallel.distributed import is_coordinator
+
+        if not is_coordinator():
+            return  # coordinator-only emission (the driver role)
+        with_names = self.collect_result(result)
+        for name, pc1, pc2, dataset in with_names:
             print(f"{name}\t{dataset}\t{pc1}\t{pc2}")
         if self.conf.output_path:
             path = self.conf.output_path + "-pca.tsv"
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             with open(path, "w") as f:
-                for name, pc1, pc2, dataset in sorted(with_names):
+                for name, pc1, pc2, dataset in with_names:
                     f.write(f"{name}\t{pc1}\t{pc2}\t{dataset}\n")
 
     # -- observability -------------------------------------------------------
@@ -1489,6 +1506,32 @@ class VariantsPcaDriver:
 
     # -- orchestration -------------------------------------------------------
 
+    def ingest_gramian(self):
+        """Stages 1-4 as one call: route the configured ingest tier and
+        return the finished (N, N) Gramian.
+
+        This is the run loop's ingest half, extracted so the serving
+        engine (serving/engine.py) can drive the same tier routing per
+        job without the emission/report side effects of :meth:`run` —
+        the two callers provably cannot diverge because this is the only
+        copy of the routing.
+        """
+        if self.conf.checkpoint_dir and (
+            len(self.conf.variant_set_ids) == 1
+            or self.conf.elastic_checkpoint
+        ):
+            return self.get_similarity_matrix_checkpointed()
+        if self._fused_csr_possible():
+            return self.get_similarity_matrix_csr(self.get_csr_fused())
+        if self._fused_ingest_possible():
+            return self.get_similarity_matrix(self.get_calls_fused())
+        if self._fused_multi_possible():
+            return self.get_similarity_matrix(self.get_calls_fused_multi())
+        data = self.get_data()
+        filtered = [self.filter_dataset(d) for d in data]
+        calls = self.get_calls(filtered)
+        return self.get_similarity_matrix(calls)
+
     def run(self) -> List[Tuple[str, float, float]]:
         """main() stage order — VariantsPca.scala:38-50."""
         from spark_examples_tpu.utils.tracing import StageTimer, profiler_trace
@@ -1496,24 +1539,7 @@ class VariantsPcaDriver:
         timer = StageTimer()
         with profiler_trace(self.conf.trace_dir):
             with timer.stage("ingest+gramian"):
-                if self.conf.checkpoint_dir and (
-                    len(self.conf.variant_set_ids) == 1
-                    or self.conf.elastic_checkpoint
-                ):
-                    g = self.get_similarity_matrix_checkpointed()
-                elif self._fused_csr_possible():
-                    g = self.get_similarity_matrix_csr(self.get_csr_fused())
-                elif self._fused_ingest_possible():
-                    g = self.get_similarity_matrix(self.get_calls_fused())
-                elif self._fused_multi_possible():
-                    g = self.get_similarity_matrix(
-                        self.get_calls_fused_multi()
-                    )
-                else:
-                    data = self.get_data()
-                    filtered = [self.filter_dataset(d) for d in data]
-                    calls = self.get_calls(filtered)
-                    g = self.get_similarity_matrix(calls)
+                g = self.ingest_gramian()
             with timer.stage("pca"):
                 result = self.compute_pca(g, timer=timer)
             with timer.stage("emit"):

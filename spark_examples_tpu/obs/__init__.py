@@ -32,6 +32,7 @@ plane pays nothing unless someone asked for telemetry.
 from spark_examples_tpu.obs.tracer import (
     SpanTracer,
     collection_active,
+    counter,
     get_tracer,
     instant,
     set_tracer,
@@ -59,6 +60,7 @@ from spark_examples_tpu.obs.session import (
 __all__ = [
     "SpanTracer",
     "collection_active",
+    "counter",
     "get_tracer",
     "set_tracer",
     "span",

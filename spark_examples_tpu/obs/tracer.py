@@ -42,6 +42,7 @@ from typing import Any, Dict, Iterator, List, Optional
 __all__ = [
     "SpanTracer",
     "collection_active",
+    "counter",
     "get_tracer",
     "set_tracer",
     "span",
@@ -292,3 +293,11 @@ def instant(name: str, scope: str = "t", **args: Any) -> None:
     """Ambient instant event: no-op unless a session is active."""
     if _active:
         get_tracer().instant(name, scope=scope, **args)
+
+
+def counter(name: str, **series: float) -> None:
+    """Ambient counter ("C") sample — a stacked-area track in the
+    viewer (queue depth, in-flight jobs). No-op unless a session is
+    active, like every ambient helper."""
+    if _active:
+        get_tracer().counter(name, **series)

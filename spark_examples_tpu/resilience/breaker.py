@@ -59,6 +59,27 @@ class CircuitOpenError(IOError):
         self.retry_in = retry_in
 
 
+def _record_probe(endpoint: str, outcome: str) -> None:
+    """Half-open probe observability: without this, shed-vs-probe
+    behavior is invisible on the timeline — an operator cannot tell "the
+    breaker is probing its way back" from "the breaker is wedged open".
+    Outcomes: ``admitted`` (a probe slot granted), ``success`` (the
+    probe closed the circuit), ``failure`` (the probe re-opened it),
+    ``released`` (slot returned with no verdict — an abandoned
+    stream)."""
+    from spark_examples_tpu import obs
+    from spark_examples_tpu.obs.tracer import collection_active
+
+    obs.instant(
+        "breaker_probe", scope="p", endpoint=endpoint, outcome=outcome
+    )
+    if collection_active():
+        obs.get_registry().counter(
+            "breaker_probe_total",
+            "Half-open circuit-breaker probe outcomes per endpoint",
+        ).labels(endpoint=endpoint, outcome=outcome).inc()
+
+
 def _record_transition(endpoint: str, from_state: str, to_state: str) -> None:
     from spark_examples_tpu import obs
     from spark_examples_tpu.obs.tracer import collection_active
@@ -129,6 +150,7 @@ class CircuitBreaker:
                         self.cooldown_s - (self._clock() - self._opened_at),
                     )
                 self._probes_in_flight += 1
+                _record_probe(self.endpoint, "admitted")
 
     def record_success(self) -> None:
         """Record transport-level liveness: a returned result OR a
@@ -136,6 +158,7 @@ class CircuitBreaker:
         classifiers' non-retryable verdict). Closes a half-open probe."""
         with self._lock:
             if self._state == HALF_OPEN:
+                _record_probe(self.endpoint, "success")
                 self._transition(CLOSED)
                 self._probes_in_flight = 0
             self._failures = 0
@@ -148,6 +171,7 @@ class CircuitBreaker:
         with self._lock:
             if self._state == HALF_OPEN and self._probes_in_flight > 0:
                 self._probes_in_flight -= 1
+                _record_probe(self.endpoint, "released")
 
     def record_failure(self) -> None:
         """Count one RETRYABLE failure (the classifier's verdict — a
@@ -155,6 +179,7 @@ class CircuitBreaker:
         with self._lock:
             if self._state == HALF_OPEN:
                 # The probe failed: re-open and re-arm the cooldown.
+                _record_probe(self.endpoint, "failure")
                 self._transition(OPEN)
                 self._opened_at = self._clock()
                 self._probes_in_flight = 0

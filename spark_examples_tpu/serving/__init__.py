@@ -1,0 +1,50 @@
+"""PCA-as-a-service: the multi-tenant analysis job tier.
+
+``genomics/service.py`` fronts this package with ``POST /analyze`` +
+``GET /jobs/<id>``: clients submit cohort specs and the server
+schedules PCA runs against its resident source. Robustness is the
+architecture — admission control (circuit breaker + bounded priority
+queue + per-tenant quotas + 429/Retry-After shedding), a crash-safe
+append-only job journal with deterministic replay, a result cache with
+single-flight dedup keyed on the cohort hash, and a re-entrant
+execution engine extracted from the batch driver. See
+docs/OPERATIONS.md ("running the analysis service") and
+docs/RESILIENCE.md (the ``serving.*`` fault seams).
+
+Import note: this package stays jax-free at import time (the engine
+imports the driver lazily), so a host-only ``serve-cohort`` without
+``--analyze`` never pays the jax import.
+"""
+
+from spark_examples_tpu.serving.engine import AnalysisEngine
+from spark_examples_tpu.serving.jobs import (
+    Job,
+    JobJournal,
+    JobSpec,
+    cohort_key,
+    job_config,
+)
+from spark_examples_tpu.serving.queue import (
+    AdmissionError,
+    AdmissionQueue,
+    JournalUnavailableError,
+    QueueFullError,
+    QuotaExceededError,
+)
+from spark_examples_tpu.serving.tier import AnalysisJobTier, SimulatedCrash
+
+__all__ = [
+    "AdmissionError",
+    "AdmissionQueue",
+    "AnalysisEngine",
+    "AnalysisJobTier",
+    "Job",
+    "JobJournal",
+    "JobSpec",
+    "JournalUnavailableError",
+    "QueueFullError",
+    "QuotaExceededError",
+    "SimulatedCrash",
+    "cohort_key",
+    "job_config",
+]

@@ -1,0 +1,361 @@
+"""Job model + crash-safe journal for the analysis service.
+
+A submission is a :class:`JobSpec` — the cohort spec a client POSTs to
+``/analyze`` (dataset, references window, AF filter, k) plus tenant and
+priority. Its :func:`cohort_key` is a MurmurHash3 x64-128 digest
+(:mod:`spark_examples_tpu.genomics.hashing` — the same hash the
+variant-identity join uses) over the RESOLVED analysis parameters, and
+is the unit of result caching and single-flight dedup: two submissions
+that would compute the same coordinates share one key, whoever their
+tenants are (arxiv 1909.00954's observation that cohorts share most of
+G is what makes the cache the common case, not a luxury).
+
+The :class:`JobJournal` is the crash-safety spine: an append-only JSONL
+event log (submit/start/done/fail), flushed per append and fsynced
+through the watchdog's pre-exit flush hook, written under the same
+torn-write discipline ``utils/checkpoint.py`` drills — the loader
+tolerates a torn tail (the bytes a SIGKILL mid-append leaves) by
+skipping unparseable lines with a warning, never by dying on its own
+safety net. Replaying the journal reconstructs every job
+deterministically: finished jobs re-populate the result cache, and
+jobs that were queued or running when the process died re-queue in
+original submission order.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "Job",
+    "JobJournal",
+    "JobSpec",
+    "cohort_key",
+    "JOB_QUEUED",
+    "JOB_RUNNING",
+    "JOB_DONE",
+    "JOB_FAILED",
+]
+
+JOB_QUEUED = "queued"
+JOB_RUNNING = "running"
+JOB_DONE = "done"
+JOB_FAILED = "failed"
+
+_JOURNAL_NAME = "jobs.journal.jsonl"
+
+# Spec fields a client may set; anything else in the POST body is a
+# loud 400, not a silent ignore — a typo'd "min_allele_freq" that
+# silently ran unfiltered would be a correctness bug shipped as data.
+_SPEC_FIELDS = frozenset(
+    {
+        "tenant",
+        "variant_set_id",
+        "variant_set_ids",
+        "references",
+        "all_references",
+        "min_allele_frequency",
+        "num_pc",
+        "priority",
+    }
+)
+
+
+@dataclass(frozen=True)
+class JobSpec:
+    """One client-submitted analysis: cohort spec + tenant + priority."""
+
+    # None (or an empty tuple) = inherit the server's configured
+    # default for that field — a client submitting {} analyzes exactly
+    # the cohort the server's own batch run would.
+    tenant: str = "anonymous"
+    variant_set_ids: Tuple[str, ...] = ()
+    references: Optional[str] = None
+    all_references: Optional[bool] = None
+    min_allele_frequency: Optional[float] = None
+    num_pc: Optional[int] = None
+    priority: int = 0
+
+    @classmethod
+    def from_record(cls, rec: Dict[str, Any]) -> "JobSpec":
+        """Parse + validate a client JSON body (ValueError = HTTP 400)."""
+        if not isinstance(rec, dict):
+            raise ValueError("analysis spec must be a JSON object")
+        unknown = set(rec) - _SPEC_FIELDS
+        if unknown:
+            raise ValueError(
+                f"unknown spec field(s): {sorted(unknown)} "
+                f"(expected a subset of {sorted(_SPEC_FIELDS)})"
+            )
+        vsids = rec.get("variant_set_ids")
+        if vsids is None:
+            one = rec.get("variant_set_id")
+            vsids = [one] if one else []
+        if not isinstance(vsids, (list, tuple)) or not all(
+            isinstance(v, str) and v for v in vsids
+        ):
+            raise ValueError("variant_set_ids must be non-empty strings")
+        af = rec.get("min_allele_frequency")
+        if af is not None:
+            af = float(af)
+            if not (0.0 <= af <= 1.0):
+                raise ValueError("min_allele_frequency must be in [0, 1]")
+        num_pc = rec.get("num_pc")
+        if num_pc is not None:
+            num_pc = int(num_pc)
+            if num_pc < 1:
+                raise ValueError(f"num_pc must be >= 1, got {num_pc}")
+        priority = int(rec.get("priority", 0))
+        if not (-10 <= priority <= 10):
+            # Priority is a cooperative nudge between trusted clients,
+            # not a bidding war: an unbounded value would let one
+            # tenant park above everyone else forever (the per-tenant
+            # quota bounds volume, not position).
+            raise ValueError(
+                f"priority must be in [-10, 10], got {priority}"
+            )
+        refs = rec.get("references")
+        if refs is not None and not isinstance(refs, str):
+            raise ValueError("references must be a string")
+        all_refs = rec.get("all_references")
+        return cls(
+            tenant=str(rec.get("tenant", "anonymous")) or "anonymous",
+            variant_set_ids=tuple(vsids),
+            references=refs,
+            all_references=(
+                None if all_refs is None else bool(all_refs)
+            ),
+            min_allele_frequency=af,
+            num_pc=num_pc,
+            priority=priority,
+        )
+
+    def to_record(self) -> Dict[str, Any]:
+        return {
+            "tenant": self.tenant,
+            "variant_set_ids": list(self.variant_set_ids),
+            "references": self.references,
+            "all_references": self.all_references,
+            "min_allele_frequency": self.min_allele_frequency,
+            "num_pc": self.num_pc,
+            "priority": self.priority,
+        }
+
+
+def resolve_spec(spec: JobSpec, base) -> Dict[str, Any]:
+    """The spec with server defaults applied — the EXACT parameter set a
+    job will run with, which is therefore what the cohort key must
+    cover (``base`` is the server's PcaConfig template)."""
+    return {
+        "variant_set_ids": list(
+            spec.variant_set_ids or base.variant_set_ids
+        ),
+        "references": (
+            spec.references
+            if spec.references is not None
+            else base.references
+        ),
+        "all_references": (
+            spec.all_references
+            if spec.all_references is not None
+            else bool(base.all_references)
+        ),
+        "min_allele_frequency": (
+            spec.min_allele_frequency
+            if spec.min_allele_frequency is not None
+            else base.min_allele_frequency
+        ),
+        "num_pc": (
+            spec.num_pc if spec.num_pc is not None else base.num_pc
+        ),
+    }
+
+
+def cohort_key(spec: JobSpec, base) -> str:
+    """Hex result-cache key: murmur3_x64_128 over the canonical JSON of
+    the resolved analysis parameters. Tenant and priority are excluded
+    ON PURPOSE — identical analyses share results across tenants (the
+    whole point of the cache)."""
+    from spark_examples_tpu.genomics.hashing import murmur3_x64_128
+
+    payload = json.dumps(
+        resolve_spec(spec, base), sort_keys=True, separators=(",", ":")
+    ).encode("utf-8")
+    return murmur3_x64_128(payload).hex()
+
+
+def job_config(spec: JobSpec, base, checkpoint_dir: Optional[str] = None):
+    """Per-job PcaConfig: the server template with the spec's analysis
+    parameters applied and every emission/telemetry output stripped
+    (jobs return rows; they never write the operator's artifacts)."""
+    import dataclasses
+
+    resolved = resolve_spec(spec, base)
+    return dataclasses.replace(
+        base,
+        variant_set_ids=resolved["variant_set_ids"],
+        references=resolved["references"],
+        all_references=resolved["all_references"],
+        min_allele_frequency=resolved["min_allele_frequency"],
+        num_pc=resolved["num_pc"],
+        checkpoint_dir=checkpoint_dir,
+        elastic_checkpoint=False,
+        output_path=None,
+        trace_dir=None,
+        trace_out=None,
+        metrics_out=None,
+        manifest_out=None,
+    )
+
+
+@dataclass
+class Job:
+    """One admitted submission's lifecycle (in-memory view; the journal
+    is the durable truth)."""
+
+    id: str
+    spec: JobSpec
+    key: str
+    seq: int
+    state: str = JOB_QUEUED
+    cached: bool = False
+    error: Optional[str] = None
+    result: Optional[List[Tuple[str, float, float, str]]] = None
+    submitted_unix: float = field(default_factory=time.time)
+
+    def to_record(self, include_result: bool = True) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "id": self.id,
+            "state": self.state,
+            "tenant": self.spec.tenant,
+            "cached": self.cached,
+            "submitted_unix": self.submitted_unix,
+            "spec": self.spec.to_record(),
+        }
+        if self.error is not None:
+            rec["error"] = self.error
+        if include_result and self.result is not None:
+            rec["result"] = [list(row) for row in self.result]
+        return rec
+
+
+class JobJournal:
+    """Append-only JSONL event log — the tier's crash-safe state.
+
+    Every append is flushed to the OS immediately; the watchdog's
+    pre-exit flush hook (``utils/watchdog.py``) additionally fsyncs it
+    on the exit-77 fail-stop path, so a collective-timeout kill leaves
+    the journal as durable as a clean shutdown. The ``serving.journal.
+    append`` fault seam injects torn/error writes for the chaos suite.
+    """
+
+    def __init__(self, directory: str) -> None:
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.path = os.path.join(directory, _JOURNAL_NAME)
+        self._lock = threading.Lock()
+        self._f = open(self.path, "ab")
+        # Heal a crash-torn tail BEFORE the first append: a kill mid-
+        # write leaves a partial line with no newline, and appending
+        # straight after it would merge the next (acknowledged!) event
+        # into one unparseable line — silently destroying it on every
+        # later replay. Terminating the torn bytes keeps them an
+        # isolated skip-with-warning line, exactly what replay expects.
+        if self._f.tell() > 0:
+            with open(self.path, "rb") as probe:
+                probe.seek(-1, os.SEEK_END)
+                if probe.read(1) != b"\n":
+                    self._f.write(b"\n")
+                    self._f.flush()
+        from spark_examples_tpu.utils.watchdog import register_flush_hook
+
+        self._hook_name = f"job-journal:{self.path}"
+        register_flush_hook(self._hook_name, self.flush)
+
+    def append(self, event: Dict[str, Any]) -> None:
+        from spark_examples_tpu.resilience import faults
+
+        line = (
+            json.dumps(event, separators=(",", ":")) + "\n"
+        ).encode("utf-8")
+        with self._lock:
+            rule = faults.take(
+                "serving.journal.append", key=str(event.get("e", ""))
+            )
+            if rule is not None and rule.kind == "torn":
+                # A torn append: half the bytes, no newline — exactly
+                # what a SIGKILL mid-write leaves. The replay loader
+                # must skip it; the NEXT append would corrupt it
+                # further, so a torn rule models the crash-final write.
+                self._f.write(line[: max(1, len(line) // 2)])
+                self._f.flush()
+                return
+            if rule is not None:
+                raise faults.InjectedFault(
+                    "serving.journal.append", rule.kind, self.path,
+                    rule.message,
+                )
+            self._f.write(line)
+            self._f.flush()
+
+    def flush(self) -> None:
+        """Flush + fsync (the watchdog pre-exit hook target).
+
+        Bounded lock wait: this runs on the fail-stop path, where a
+        writer wedged inside an append (hung NFS — exactly the kind of
+        stall that fired the watchdog) may hold the lock forever. The
+        exit-77 guarantee outranks the fsync: give up after 2 s rather
+        than convert fail-stop into a permanent hang. (The fsync itself
+        can also wedge on hung storage; the watchdog bounds the whole
+        hook pass with a daemon-thread deadline for that case.)
+        """
+        if not self._lock.acquire(timeout=2.0):
+            return
+        try:
+            if self._f.closed:
+                return
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        finally:
+            self._lock.release()
+
+    def close(self) -> None:
+        from spark_examples_tpu.utils.watchdog import unregister_flush_hook
+
+        unregister_flush_hook(self._hook_name)
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
+
+    @staticmethod
+    def replay_events(directory: str) -> Iterator[Dict[str, Any]]:
+        """Parsed journal events in append order; unparseable lines (a
+        torn tail) are warned about and skipped — resume must degrade
+        to re-running, never die on its own safety net."""
+        path = os.path.join(directory, _JOURNAL_NAME)
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            for lineno, raw in enumerate(f, 1):
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    doc = json.loads(raw)
+                except ValueError:
+                    print(
+                        f"WARNING: skipping torn/corrupt journal line "
+                        f"{path}:{lineno} ({len(raw)} bytes) — jobs it "
+                        "described re-run from their last durable event.",
+                        file=sys.stderr,
+                    )
+                    continue
+                if isinstance(doc, dict):
+                    yield doc

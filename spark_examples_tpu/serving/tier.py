@@ -1,0 +1,576 @@
+"""The analysis job tier: admission → journal → workers → results.
+
+Ties the serving pieces into the object ``genomics/service.py`` fronts
+with ``POST /analyze`` + ``GET /jobs/<id>``:
+
+- **admission**: the per-endpoint :class:`CircuitBreaker` gates
+  submissions first (job-execution failures with an IO shape feed it,
+  so a dead upstream source trips the fuse and new submissions shed
+  instantly with a Retry-After instead of queuing jobs that will die);
+  then the bounded :class:`AdmissionQueue` applies capacity and
+  per-tenant quotas (429 + Retry-After, derived from
+  ``RetryPolicy.backoff_delay``);
+- **single-flight dedup + result cache**: submissions are keyed by
+  :func:`cohort_key`; an identical in-flight submission returns the
+  SAME job (one execution, any number of waiters), and a finished key
+  is served from the result cache without touching the queue at all;
+- **crash-safe journal**: every state transition is appended to the
+  :class:`JobJournal` before it is observable, so a ``kill -9`` at any
+  point leaves a journal a restarted tier replays deterministically —
+  done jobs stay queryable (and warm the cache), in-flight jobs
+  re-queue in original order, and a re-run produces bit-identical
+  coordinates (deterministic manifest + integer-exact accumulation,
+  the same invariant the chaos harness pins for ingest);
+- **resumable gramians**: with a journal directory, each single-dataset
+  job also gets a per-job checkpoint dir, so a job killed mid-Gramian
+  resumes from its last shard-group snapshot instead of from zero.
+
+Fault seams (docs/RESILIENCE.md): ``serving.job.run`` (error/stall =
+job execution failure/slow job), ``serving.job.kill`` (a simulated
+process death between the journaled start and execution — the
+deterministic stand-in for ``kill -9`` the chaos tests drive), and
+``serving.journal.append`` (torn/error journal writes).
+"""
+
+from __future__ import annotations
+
+import collections
+import shutil
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from spark_examples_tpu.serving.jobs import (
+    JOB_DONE,
+    JOB_FAILED,
+    JOB_QUEUED,
+    JOB_RUNNING,
+    Job,
+    JobJournal,
+    JobSpec,
+    cohort_key,
+    job_config,
+)
+from spark_examples_tpu.serving.queue import (
+    AdmissionQueue,
+    DEFAULT_QUEUE_DEPTH,
+    DEFAULT_TENANT_QUOTA,
+)
+
+__all__ = [
+    "AnalysisJobTier",
+    "SimulatedCrash",
+    "DEFAULT_RESULT_CACHE",
+    "DEFAULT_JOB_RETENTION",
+]
+
+DEFAULT_RESULT_CACHE = 256
+
+# Terminal (done/failed) jobs kept queryable in memory: beyond this the
+# oldest are evicted (their results live on in the LRU cache / journal).
+# Without a bound, weeks of steady traffic grow the job table — and its
+# retained result rows — into exactly the overload-to-OOM conversion
+# the admission queue exists to prevent.
+DEFAULT_JOB_RETENTION = 1024
+
+
+class SimulatedCrash(RuntimeError):
+    """The ``serving.job.kill`` seam fired: this worker must die AS IF
+    the process were killed — no failure event reaches the journal, no
+    quota is released, the job stays 'running' in the abandoned tier."""
+
+
+class _ResultCache:
+    """Bounded LRU of cohort_key → (job_id, rows) (thread-safe)."""
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = max(1, capacity)
+        self._lock = threading.Lock()
+        self._items: "collections.OrderedDict[str, Tuple[str, list]]" = (
+            collections.OrderedDict()
+        )
+
+    def get(self, key: str) -> Optional[Tuple[str, list]]:
+        with self._lock:
+            hit = self._items.get(key)
+            if hit is not None:
+                self._items.move_to_end(key)
+            return hit
+
+    def put(self, key: str, job_id: str, rows: list) -> None:
+        with self._lock:
+            self._items[key] = (job_id, rows)
+            self._items.move_to_end(key)
+            while len(self._items) > self.capacity:
+                self._items.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+def _count_job(outcome: str) -> None:
+    from spark_examples_tpu import obs
+    from spark_examples_tpu.obs.tracer import collection_active
+
+    if collection_active():
+        obs.get_registry().counter(
+            "serving_jobs_total",
+            "Analysis job submissions by outcome "
+            "(done/failed/cached/deduped)",
+        ).labels(outcome=outcome).inc()
+
+
+class AnalysisJobTier:
+    """The object the HTTP surface fronts (one per server process)."""
+
+    def __init__(
+        self,
+        engine,
+        base_config,
+        queue_depth: int = DEFAULT_QUEUE_DEPTH,
+        tenant_quota: int = DEFAULT_TENANT_QUOTA,
+        workers: int = 1,
+        journal_dir: Optional[str] = None,
+        cache_size: int = DEFAULT_RESULT_CACHE,
+        breakers=None,
+        job_retention: int = DEFAULT_JOB_RETENTION,
+    ) -> None:
+        from spark_examples_tpu.resilience import BreakerSet
+
+        self._engine = engine
+        self._base = base_config
+        self._queue = AdmissionQueue(queue_depth, tenant_quota)
+        self._cache = _ResultCache(cache_size)
+        self._breaker = (breakers or BreakerSet("serving:")).get("analyze")
+        self._lock = threading.RLock()
+        self._jobs: Dict[str, Job] = {}
+        self._by_key: Dict[str, str] = {}  # active cohort_key → job id
+        self._retention = max(1, job_retention)
+        self._seq = 0
+        self._journal = (
+            JobJournal(journal_dir) if journal_dir else None
+        )
+        self._journal_dir = journal_dir
+        self._stop = threading.Event()
+        self._workers: List[threading.Thread] = []
+        self._n_workers = max(0, workers)
+        if self._journal is not None:
+            self._replay()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "AnalysisJobTier":
+        """Spawn the worker threads (``workers=0`` = none; callers then
+        drive execution with :meth:`step` — the deterministic test
+        mode)."""
+        for i in range(self._n_workers):
+            t = threading.Thread(
+                target=self._worker_loop,
+                name=f"analysis-worker-{i}",
+                daemon=True,
+            )
+            t.start()
+            self._workers.append(t)
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        # Unblock workers parked in pop(): the queue wakes on notify,
+        # and pop() uses a bounded wait, so the stop flag is observed.
+        for t in self._workers:
+            t.join(timeout=10.0)
+        if self._journal is not None:
+            self._journal.close()
+
+    # -- submission -----------------------------------------------------------
+
+    def submit(self, spec: JobSpec) -> Tuple[Job, bool]:
+        """Admit one submission → ``(job, created)``.
+
+        ``created`` False = served without new work (result cache hit
+        or single-flight dedup onto an in-flight identical job). Raises
+        ``CircuitOpenError`` (breaker shedding) or an
+        :class:`~spark_examples_tpu.serving.queue.AdmissionError`
+        (queue full / tenant quota) — the HTTP surface maps those to
+        503/429 + Retry-After.
+        """
+        from spark_examples_tpu import obs
+
+        key = cohort_key(spec, self._base)
+        with self._lock:
+            hit = self._cache.get(key)
+            if hit is not None:
+                job_id, rows = hit
+                _count_job("cached")
+                # A caller-scoped VIEW, never the original record: the
+                # rows are shared across tenants by design, the
+                # submitter's identity/spec are not — and mutating the
+                # original's `cached` flag would corrupt its own
+                # submitter's poll.
+                return (
+                    Job(
+                        id=job_id, spec=spec, key=key, seq=-1,
+                        state=JOB_DONE, cached=True, result=rows,
+                    ),
+                    False,
+                )
+            active_id = self._by_key.get(key)
+            if active_id is not None:
+                active = self._jobs[active_id]
+                _count_job("deduped")
+                return (
+                    Job(
+                        id=active.id, spec=spec, key=key,
+                        seq=active.seq, state=active.state,
+                        error=active.error, result=active.result,
+                    ),
+                    False,
+                )
+            # Breaker admission: a half-open probe slot taken here is
+            # settled by the job's eventual outcome (record_success /
+            # record_failure in the worker), so probes measure real job
+            # executions, not merely the act of queuing.
+            self._breaker.before_call()  # raises CircuitOpenError
+            self._seq += 1
+            seq = self._seq
+            job = Job(
+                id=f"j-{key[:12]}-{seq}", spec=spec, key=key, seq=seq
+            )
+            try:
+                self._queue.admit(job, spec.tenant, spec.priority, seq)
+            except Exception:
+                # The shed verdict belongs to the queue, not the
+                # endpoint: give back any half-open probe slot the
+                # breaker just granted, with no verdict.
+                self._breaker.release_probe()
+                raise
+            self._jobs[job.id] = job
+            self._by_key[key] = job.id
+        # Journal OUTSIDE the tier lock: the append is disk I/O, and
+        # holding the lock across it would stall every /jobs poll on a
+        # slow disk. The 202 still goes out only after the append
+        # returns — the client-visible contract holds.
+        if self._journal is not None:
+            try:
+                self._journal.append(
+                    {
+                        "e": "submit",
+                        "id": job.id,
+                        "seq": seq,
+                        "key": key,
+                        "spec": spec.to_record(),
+                        "ts": job.submitted_unix,
+                    }
+                )
+            except Exception as e:  # noqa: BLE001 — disk weather
+                self._rollback_submit(job, key, e)  # raises
+        obs.instant(
+            "job_transition", scope="p", id=job.id, to=JOB_QUEUED
+        )
+        return job, True
+
+    def _rollback_submit(self, job: Job, key: str, exc: Exception) -> None:
+        """Crash-safety contract: a job the journal cannot record must
+        not run (it would vanish from resume). Un-admit it — removing
+        its heap entry so no phantom consumes capacity — and shed
+        retryably; disk conditions clear. If a worker raced us and
+        already took the job, let it finish (its result is correct and
+        cached; its orphan journal events are skipped by replay)."""
+        from spark_examples_tpu.serving.queue import (
+            JournalUnavailableError,
+            note_shed,
+        )
+
+        with self._lock:
+            self._jobs.pop(job.id, None)
+            if self._by_key.get(key) == job.id:
+                self._by_key.pop(key, None)
+            if self._queue.discard(job, job.spec.tenant):
+                if job.state == JOB_QUEUED:
+                    job.error = f"journal write failed: {exc}"
+                    job.state = JOB_FAILED
+                # Only an un-run job gives its half-open probe slot
+                # back; if a worker already took it, that execution IS
+                # the probe and settles the breaker itself — releasing
+                # here too would admit a second concurrent probe past
+                # the bound.
+                self._breaker.release_probe()
+        note_shed("journal")
+        raise JournalUnavailableError(
+            f"analysis journal unavailable ({exc}); "
+            "submission not accepted",
+            5.0,
+        ) from exc
+
+    def job(self, job_id: str) -> Optional[Job]:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def jobs(self) -> List[Job]:
+        with self._lock:
+            return sorted(self._jobs.values(), key=lambda j: j.seq)
+
+    def queue_depth(self) -> int:
+        return self._queue.depth()
+
+    # -- execution ------------------------------------------------------------
+
+    def step(self, timeout: float = 0.0) -> bool:
+        """Run one queued job on the caller's thread (the worker body,
+        exposed for deterministic tests and ``workers=0`` tiers).
+        Returns False when nothing runnable was queued."""
+        while True:
+            job = self._queue.pop(timeout=timeout)
+            if job is None:
+                return False
+            if job.state != JOB_QUEUED:
+                continue  # a rolled-back admission's stale heap entry
+            self._execute(job)
+            return True
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            job = self._queue.pop(timeout=0.25)
+            if job is None:
+                continue
+            try:
+                self._execute(job)
+            except SimulatedCrash as e:
+                print(
+                    f"analysis worker crashed (simulated kill): {e}",
+                    file=sys.stderr,
+                )
+                return  # the thread dies, as the process would
+            except Exception as e:  # noqa: BLE001 — worker survival
+                # _execute isolates job failures itself; anything that
+                # still escapes (a tier-level bug) must not silently
+                # kill the only worker and wedge every queued job.
+                print(
+                    f"WARNING: analysis worker error on {job.id}: "
+                    f"{type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+
+    def _ckpt_dir(self, job: Job) -> Optional[str]:
+        # Per-job Gramian snapshots make a killed job RESUME mid-ingest
+        # instead of restarting; the checkpointed route is single-
+        # variantset only, so multi-set jobs simply re-run (still
+        # bit-identical — the manifest is deterministic).
+        import os
+
+        spec_vsids = job.spec.variant_set_ids or tuple(
+            self._base.variant_set_ids
+        )
+        if self._journal_dir is None or len(spec_vsids) != 1:
+            return None
+        return os.path.join(self._journal_dir, "ckpt", job.id)
+
+    def _journal_append_safe(self, event: Dict) -> None:
+        """Append a TRANSITION event (start/done/fail), degrading loudly
+        on failure instead of killing the worker: losing a transition
+        only costs resume WORK, never correctness — replay re-queues
+        the job and re-execution is bit-identical. (Submit events are
+        different: those must land or the job is rolled back.)"""
+        if self._journal is None:
+            return
+        try:
+            self._journal.append(event)
+        except Exception as e:  # noqa: BLE001 — disk weather
+            from spark_examples_tpu import obs
+
+            print(
+                f"WARNING: journal append failed "
+                f"({type(e).__name__}: {e}); job {event.get('id')} "
+                "will re-run from its last durable event on resume.",
+                file=sys.stderr,
+            )
+            obs.instant(
+                "journal_append_failed",
+                scope="p",
+                id=str(event.get("id", "")),
+                event=str(event.get("e", "")),
+            )
+
+    def _execute(self, job: Job) -> None:
+        from spark_examples_tpu import obs
+        from spark_examples_tpu.resilience import faults
+
+        with self._lock:
+            if job.state != JOB_QUEUED:
+                # A rolled-back admission's stale heap entry (terminal
+                # already): nothing to run.
+                return
+            job.state = JOB_RUNNING
+        # Disk I/O outside the tier lock (submit() reasoning).
+        self._journal_append_safe({"e": "start", "id": job.id})
+        obs.instant(
+            "job_transition", scope="p", id=job.id, to=JOB_RUNNING
+        )
+        try:
+            faults.inject("serving.job.kill", key=job.id)
+        except faults.InjectedFault as e:
+            # Leave the journal exactly as a SIGKILL here would: start
+            # recorded, no terminal event — and kill this worker.
+            raise SimulatedCrash(str(e)) from e
+        ckpt = self._ckpt_dir(job)
+        try:
+            with obs.span(
+                "job.run", job_id=job.id, tenant=job.spec.tenant
+            ):
+                faults.inject("serving.job.run", key=job.id)
+                rows = self._engine.run(
+                    job_config(job.spec, self._base, checkpoint_dir=ckpt)
+                )
+        except Exception as e:  # noqa: BLE001 — job isolation boundary
+            self._finish(job, error=f"{type(e).__name__}: {e}")
+            # IO-shaped failures (dead upstream source, injected
+            # transport weather) feed the breaker; deterministic spec
+            # errors are the tier ANSWERING and must not blow the fuse.
+            if isinstance(e, IOError):
+                self._breaker.record_failure()
+            else:
+                self._breaker.record_success()
+        else:
+            self._finish(job, rows=rows)
+            self._breaker.record_success()
+        # Snapshots belong to IN-FLIGHT work: any terminal outcome
+        # reclaims the job's checkpoint dir (a failed id is never
+        # reused — a resubmission gets a fresh seq and dir — so keeping
+        # it would only leak disk). A crash skips this on purpose: the
+        # re-queued same-id job resumes from these snapshots.
+        if ckpt is not None:
+            shutil.rmtree(ckpt, ignore_errors=True)
+
+    def _finish(
+        self,
+        job: Job,
+        rows: Optional[list] = None,
+        error: Optional[str] = None,
+    ) -> None:
+        from spark_examples_tpu import obs
+
+        with self._lock:
+            if error is None:
+                # Result BEFORE state: HTTP readers serialize the job
+                # outside this lock, checking state first — they must
+                # never observe a result-less 'done'.
+                job.result = rows
+                job.state = JOB_DONE
+                self._cache.put(job.key, job.id, rows)
+                event = {
+                    "e": "done",
+                    "id": job.id,
+                    "rows": [list(r) for r in rows],
+                }
+                _count_job("done")
+            else:
+                job.error = error
+                job.state = JOB_FAILED
+                event = {"e": "fail", "id": job.id, "error": error}
+                _count_job("failed")
+            if self._by_key.get(job.key) == job.id:
+                self._by_key.pop(job.key, None)
+            self._queue.release(job.spec.tenant)
+            self._prune_terminal_locked()
+        # Disk I/O outside the tier lock (submit() reasoning).
+        self._journal_append_safe(event)
+        obs.instant(
+            "job_transition", scope="p", id=job.id, to=job.state
+        )
+
+    def _prune_terminal_locked(self) -> None:
+        """Evict the oldest terminal jobs beyond the retention bound
+        (active jobs are never evicted; recent results stay reachable
+        through the LRU cache and the journal regardless)."""
+        terminal = [
+            j
+            for j in self._jobs.values()
+            if j.state in (JOB_DONE, JOB_FAILED)
+        ]
+        excess = len(terminal) - self._retention
+        if excess <= 0:
+            return
+        terminal.sort(key=lambda j: j.seq)
+        for job in terminal[:excess]:
+            self._jobs.pop(job.id, None)
+
+    # -- crash recovery -------------------------------------------------------
+
+    def _replay(self) -> None:
+        """Rebuild state from the journal: done/failed jobs restore the
+        queryable table (+ warm cache); queued/running jobs re-queue in
+        original submission order — the deterministic resume a killed
+        server owes its clients."""
+        from spark_examples_tpu import obs
+
+        with obs.span("job.replay", journal=self._journal.path):
+            events = list(JobJournal.replay_events(self._journal_dir))
+            for e in events:
+                kind = e.get("e")
+                if kind == "submit":
+                    try:
+                        spec = JobSpec.from_record(e["spec"])
+                    except (KeyError, ValueError) as exc:
+                        print(
+                            f"WARNING: journaled spec for {e.get('id')} "
+                            f"is unusable ({exc}); dropping it.",
+                            file=sys.stderr,
+                        )
+                        continue
+                    seq = int(e.get("seq", 0))
+                    job = Job(
+                        id=str(e["id"]),
+                        spec=spec,
+                        key=str(e.get("key") or cohort_key(spec, self._base)),
+                        seq=seq,
+                        submitted_unix=float(e.get("ts", 0.0)),
+                    )
+                    self._jobs[job.id] = job
+                    self._seq = max(self._seq, seq)
+                elif kind in ("start", "done", "fail"):
+                    job = self._jobs.get(str(e.get("id", "")))
+                    if job is None:
+                        continue
+                    if kind == "start":
+                        job.state = JOB_RUNNING
+                    elif kind == "done":
+                        job.state = JOB_DONE
+                        job.result = [
+                            tuple(r) for r in e.get("rows", [])
+                        ]
+                        self._cache.put(job.key, job.id, job.result)
+                    else:
+                        job.state = JOB_FAILED
+                        job.error = str(e.get("error", ""))
+            requeue = sorted(
+                (
+                    j
+                    for j in self._jobs.values()
+                    if j.state in (JOB_QUEUED, JOB_RUNNING)
+                ),
+                key=lambda j: j.seq,
+            )
+            for job in requeue:
+                job.state = JOB_QUEUED
+                self._by_key[job.key] = job.id
+                # Bypass shed checks: the crashed server already
+                # admitted these — resume must not drop admitted work.
+                self._queue.readmit(
+                    job, job.spec.tenant, job.spec.priority, job.seq
+                )
+                obs.instant(
+                    "job_transition", scope="p", id=job.id, to=JOB_QUEUED
+                )
+            # The journal holds the server's whole history; the
+            # in-memory table is bounded the same way it is live.
+            self._prune_terminal_locked()
+            if self._jobs:
+                done = sum(
+                    1 for j in self._jobs.values() if j.state == JOB_DONE
+                )
+                print(
+                    f"Analysis journal replayed: {len(self._jobs)} "
+                    f"job(s), {done} done (cache warm), "
+                    f"{len(requeue)} re-queued."
+                )

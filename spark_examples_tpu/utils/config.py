@@ -29,7 +29,13 @@ from spark_examples_tpu.genomics.shards import (
     shards_for_references,
 )
 
-__all__ = ["GenomicsConfig", "PcaConfig", "add_genomics_flags", "add_pca_flags"]
+__all__ = [
+    "GenomicsConfig",
+    "PcaConfig",
+    "add_analyze_flags",
+    "add_genomics_flags",
+    "add_pca_flags",
+]
 
 # Reference well-known variantset ids (SearchVariantsExample.scala:27-31).
 PLATINUM_GENOMES = "3049512673186936334"
@@ -458,6 +464,70 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         "sharp spectra. On the fused path (--pca-mode auto/fused): the "
         "residual check-and-retry bar (default 1e-3). The iteration "
         "count used appears in the stage report",
+    )
+
+
+def add_analyze_flags(p: argparse.ArgumentParser) -> None:
+    """The serve-cohort analysis-tier surface (serving/): flag defaults
+    derive from the serving layer's own constants — one source of
+    truth, like the breaker/retry flags above."""
+    from spark_examples_tpu.serving.queue import (
+        DEFAULT_QUEUE_DEPTH,
+        DEFAULT_TENANT_QUOTA,
+    )
+    from spark_examples_tpu.serving.tier import DEFAULT_RESULT_CACHE
+
+    p.add_argument(
+        "--analyze",
+        action="store_true",
+        help="Serve the multi-tenant analysis job tier: POST /analyze "
+        "submits a cohort spec (dataset, references, AF filter, num_pc) "
+        "and GET /jobs/<id> polls it; jobs run PCA against the served "
+        "cohort on this host's accelerator with admission control, "
+        "per-tenant quotas, result caching, and crash-safe resume "
+        "(docs/OPERATIONS.md)",
+    )
+    p.add_argument(
+        "--analyze-workers",
+        type=int,
+        default=1,
+        help="Analysis worker threads executing queued jobs (device "
+        "phases serialize on one engine lock regardless; extra workers "
+        "only overlap host-side work)",
+    )
+    p.add_argument(
+        "--analyze-queue-depth",
+        type=int,
+        default=DEFAULT_QUEUE_DEPTH,
+        help="Bounded analysis queue depth: submissions beyond it shed "
+        "with 429 + Retry-After (derived from the retry policy's "
+        "backoff over the consecutive-shed streak) instead of queuing "
+        "unboundedly",
+    )
+    p.add_argument(
+        "--analyze-tenant-quota",
+        type=int,
+        default=DEFAULT_TENANT_QUOTA,
+        help="Per-tenant in-flight job quota (queued + running): a "
+        "tenant at quota sheds with 429 + Retry-After so one greedy "
+        "client cannot starve the others",
+    )
+    p.add_argument(
+        "--analyze-journal-dir",
+        default=None,
+        help="Directory for the crash-safe analysis job journal (plus "
+        "per-job Gramian checkpoints): a killed server restarted with "
+        "the same directory replays finished jobs into the result "
+        "cache and re-queues in-flight ones deterministically; unset = "
+        "in-memory only (a crash forgets every job)",
+    )
+    p.add_argument(
+        "--analyze-cache-size",
+        type=int,
+        default=DEFAULT_RESULT_CACHE,
+        help="Result-cache entries kept (LRU), keyed on the cohort "
+        "hash + analysis flags: identical submissions are served "
+        "without recomputation, across tenants",
     )
 
 

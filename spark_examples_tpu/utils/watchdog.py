@@ -23,14 +23,72 @@ import contextlib
 import os
 import sys
 import threading
-from typing import Iterator, Optional
+from typing import Callable, Dict, Iterator, Optional
 
-__all__ = ["CollectiveWatchdog", "EXIT_COLLECTIVE_TIMEOUT"]
+__all__ = [
+    "CollectiveWatchdog",
+    "EXIT_COLLECTIVE_TIMEOUT",
+    "register_flush_hook",
+    "run_flush_hooks",
+    "unregister_flush_hook",
+]
 
 # Distinctive code so supervisors can tell "peer lost, relaunch me" from
 # ordinary failures (sysexits.h stops at 78; 77 = EX_NOPERM is unused in
 # this codebase).
 EXIT_COLLECTIVE_TIMEOUT = 77
+
+# Pre-exit flush hooks: durable-state owners (the analysis job journal,
+# any open checkpoint lane writer) register a flush here so the
+# fail-stop path leaves their state as durable as a clean shutdown —
+# the same guarantee telemetry already had via flush_telemetry. Keyed
+# by name so an owner can replace/unregister its own hook.
+_flush_hooks: Dict[str, Callable[[], None]] = {}
+_flush_lock = threading.Lock()
+
+
+def register_flush_hook(name: str, fn: Callable[[], None]) -> None:
+    """Register ``fn`` to run right before a fail-stop ``os._exit``
+    (latest registration under a name wins)."""
+    with _flush_lock:
+        _flush_hooks[name] = fn
+
+
+def unregister_flush_hook(name: str) -> None:
+    with _flush_lock:
+        _flush_hooks.pop(name, None)
+
+
+def run_flush_hooks(deadline_s: float = 5.0) -> None:
+    """Run every registered hook, best-effort and BOUNDED — a dying
+    process must never fail (or hang) for want of one flush. Hooks run
+    on a daemon thread joined with a deadline: a flush wedged in the
+    kernel (fsync against a hung mount — the very stall that fired the
+    watchdog) must not turn fail-stop into a permanent hang."""
+    with _flush_lock:
+        hooks = list(_flush_hooks.items())
+    if not hooks:
+        return
+
+    def run_all() -> None:
+        for name, fn in hooks:
+            try:
+                fn()
+            except Exception:  # pragma: no cover - dying anyway
+                print(
+                    f"WARNING: pre-exit flush hook {name!r} failed",
+                    file=sys.stderr,
+                )
+
+    t = threading.Thread(target=run_all, daemon=True)
+    t.start()
+    t.join(deadline_s)
+    if t.is_alive():  # pragma: no cover - requires wedged storage
+        print(
+            f"WARNING: pre-exit flush hooks still running after "
+            f"{deadline_s}s; exiting without them.",
+            file=sys.stderr,
+        )
 
 
 class CollectiveWatchdog:
@@ -70,8 +128,11 @@ class CollectiveWatchdog:
             file=sys.stderr,
             flush=True,
         )
-        # The stall must be ON the trace timeline, not only in stderr —
-        # and the trace file must exist after os._exit, so flush now.
+        # Durable state FIRST (job journal, open checkpoint lanes —
+        # whatever registered a pre-exit hook), then telemetry: the
+        # stall must be ON the trace timeline, not only in stderr, and
+        # every flushed file must exist after os._exit.
+        run_flush_hooks()
         try:
             from spark_examples_tpu import obs
 

@@ -232,6 +232,18 @@ class TestSchemaSharing:
             "schema diverged — change both sides in one PR"
         )
 
+    def test_extracted_job_spans_match_schema_exactly(self, project):
+        schema = span_contract_mod.load_schema(REPO_ROOT)
+        extracted = {
+            name
+            for name in span_contract_mod.extract_span_names(project)
+            if name.startswith("job.")
+        }
+        assert extracted == set(schema._JOB_SPANS), (
+            "emitted job.* span literals and the validate_trace "
+            "schema diverged — change both sides in one PR"
+        )
+
     def test_contract_metrics_registered_with_required_labels(self, project):
         schema = span_contract_mod.load_schema(REPO_ROOT)
         regs = span_contract_mod.extract_metric_registrations(project)
@@ -243,6 +255,12 @@ class TestSchemaSharing:
             assert name in regs, f"ingest metric {name} not registered"
             for _, _, _, labels in regs[name]:
                 assert "mode" in labels
+        for name, label in schema._LABELED_COUNTERS.items():
+            assert name in regs, f"labeled counter {name} not registered"
+            for _, _, _, labels in regs[name]:
+                assert label in labels, (
+                    f"{name} registration missing .labels({label}=...)"
+                )
 
     def test_schema_drift_is_detected(self, tmp_path):
         """End-to-end drift proof: a tree emitting an ingest span the
@@ -272,6 +290,35 @@ class TestSchemaSharing:
         messages = "\n".join(f.message for f in findings)
         assert "ingest.typo" in messages  # emitted-but-unknown direction
         assert "ingest.slice" in messages  # schema-but-unemitted direction
+
+    def test_job_span_drift_is_detected(self, tmp_path):
+        """The serving tier's job.* family gets the same two-way drift
+        gate as the ingest sub-phases."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "tier.py").write_text(
+            "from spark_examples_tpu import obs\n\n\n"
+            "def run():\n"
+            "    with obs.span('job.typo'):\n"
+            "        pass\n"
+        )
+        scripts = tmp_path / "scripts"
+        scripts.mkdir()
+        (scripts / "validate_trace.py").write_text(
+            "_JOB_SPANS = {'job.run'}\n"
+        )
+        lines = ["[tool.graftlint]", "exclude = []"]
+        for name in ALL_RULE_NAMES:
+            lines.append(f'[tool.graftlint.rules."{name}"]')
+            enabled = name == "span-contract"
+            lines.append(f"enabled = {'true' if enabled else 'false'}")
+            if enabled:
+                lines.append('paths = ["pkg"]')
+        (tmp_path / "pyproject.toml").write_text("\n".join(lines) + "\n")
+        findings, _ = run_lint(str(tmp_path), [])
+        messages = "\n".join(f.message for f in findings)
+        assert "job.typo" in messages  # emitted-but-unknown direction
+        assert "job.run" in messages  # schema-but-unemitted direction
 
 
 class TestEngineBehavior:

@@ -424,6 +424,48 @@ class TestCircuitBreaker:
         assert b.state == "closed"
         b.before_call()  # traffic flows again
 
+    def test_half_open_probe_outcomes_are_observable(self):
+        """Probe admission/release/verdicts land on the metrics surface
+        (breaker_probe_total{endpoint,outcome}) and the timeline —
+        without them, shed-vs-probe behavior is invisible and a wedged
+        half-open breaker looks exactly like a probing one."""
+        with TelemetrySession() as session:
+            b, now = self._breaker(half_open_probes=1)
+            for _ in range(3):
+                b.record_failure()
+            now[0] = 11.0
+            b.before_call()   # admitted
+            b.release_probe()  # released (abandoned, no verdict)
+            b.before_call()   # admitted again
+            b.record_failure()  # failure → re-open
+            now[0] = 22.0
+            b.before_call()   # admitted
+            b.record_success()  # success → closed
+            counter = session.registry.counter("breaker_probe_total")
+
+            def n(outcome):
+                return counter.labels(
+                    endpoint="test-endpoint", outcome=outcome
+                ).value
+
+            assert n("admitted") == 3
+            assert n("released") == 1
+            assert n("failure") == 1
+            assert n("success") == 1
+            probes = [
+                e
+                for e in session.tracer.to_chrome()["traceEvents"]
+                if e["name"] == "breaker_probe"
+            ]
+            assert [p["args"]["outcome"] for p in probes] == [
+                "admitted",
+                "released",
+                "admitted",
+                "failure",
+                "admitted",
+                "success",
+            ]
+
     def test_release_probe_returns_the_slot_without_verdict(self):
         """An abandoned probe (no success/failure recorded) gives its
         slot back so the next caller can probe."""
@@ -1028,6 +1070,80 @@ with TelemetrySession(trace_out={str(trace)!r}):
         with wd.armed("anything"):
             pass  # no timer, no exit
 
+    def test_exit77_runs_pre_exit_flush_hooks(self, tmp_path):
+        """Regression (round 6): the exit-77 path flushed telemetry but
+        no durable state. Now every registered flush hook — the job
+        journal routes itself through one — runs before ``os._exit``,
+        so resume-after-77 sees the same journal a clean shutdown
+        leaves."""
+        sentinel = tmp_path / "hook-ran"
+        journal_dir = tmp_path / "journal"
+        script = f"""
+import time
+from spark_examples_tpu.serving import JobJournal
+from spark_examples_tpu.utils.watchdog import (
+    CollectiveWatchdog,
+    register_flush_hook,
+)
+
+journal = JobJournal({str(journal_dir)!r})
+journal.append({{"e": "submit", "id": "wd-job", "seq": 1}})
+register_flush_hook(
+    "test-sentinel",
+    lambda: open({str(sentinel)!r}, "w").write("flushed"),
+)
+wd = CollectiveWatchdog(0.3)
+with wd.armed("serving flush phase"):
+    time.sleep(30)
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 77
+        assert sentinel.read_text() == "flushed"
+        from spark_examples_tpu.serving import JobJournal
+
+        events = list(JobJournal.replay_events(str(journal_dir)))
+        assert [e["id"] for e in events] == ["wd-job"]
+
+    def test_flush_hook_registry_is_best_effort(self):
+        from spark_examples_tpu.utils import watchdog
+
+        ran = []
+        watchdog.register_flush_hook(
+            "t-bad", lambda: (_ for _ in ()).throw(RuntimeError("x"))
+        )
+        watchdog.register_flush_hook("t-good", lambda: ran.append(1))
+        try:
+            watchdog.run_flush_hooks()  # the bad hook must not block
+            assert ran == [1]
+        finally:
+            watchdog.unregister_flush_hook("t-bad")
+            watchdog.unregister_flush_hook("t-good")
+
+    def test_flush_hooks_are_deadline_bounded(self):
+        """A flush wedged in the kernel (fsync on hung storage — the
+        very stall that fired the watchdog) must not turn fail-stop
+        into a permanent hang: the hook pass runs on a daemon thread
+        joined with a deadline."""
+        import time as _time
+
+        from spark_examples_tpu.utils import watchdog
+
+        gate = threading.Event()
+        watchdog.register_flush_hook("t-wedged", gate.wait)
+        try:
+            t0 = _time.monotonic()
+            watchdog.run_flush_hooks(deadline_s=0.2)
+            assert _time.monotonic() - t0 < 5.0
+        finally:
+            gate.set()  # let the daemon thread die
+            watchdog.unregister_flush_hook("t-wedged")
+
 
 # -- integration: fixture fault plane + mirror TOCTOU -------------------------
 
@@ -1551,3 +1667,134 @@ class TestChaosSoak:
             np.testing.assert_array_equal(
                 _coords(resumed), _coords(baseline)
             )
+
+
+# -- the serving chaos scenarios ----------------------------------------------
+
+
+class TestServingKillResume:
+    """Deterministic service-tier chaos (the round-6 acceptance bar):
+    a job killed mid-run resumes after restart bit-identically; a full
+    queue sheds with Retry-After instead of queuing unboundedly; and
+    per-tenant quotas hold under concurrent submission. The kill -9
+    subprocess loop is the service soak
+    (tests/test_serving.py::TestServiceChaosSoak, slow)."""
+
+    @staticmethod
+    def _tier(src, tmp_path=None, **kw):
+        from spark_examples_tpu.serving import (
+            AnalysisEngine,
+            AnalysisJobTier,
+        )
+
+        kw.setdefault("workers", 0)
+        if tmp_path is not None:
+            kw.setdefault("journal_dir", str(tmp_path / "journal"))
+        return AnalysisJobTier(
+            AnalysisEngine(src), _chaos_conf(shard_retries=1), **kw
+        )
+
+    def test_kill_mid_job_then_restart_is_bit_identical(self, tmp_path):
+        """The serving.job.kill seam leaves the journal exactly as a
+        SIGKILL between the journaled start and completion would; a new
+        tier over the same journal re-queues the job deterministically
+        and re-runs it to the SAME coordinates, with valid artifacts
+        carrying the whole story."""
+        from spark_examples_tpu.serving import (
+            AnalysisEngine,
+            JobSpec,
+            SimulatedCrash,
+        )
+
+        src = synthetic_cohort(10, 80, seed=3)
+        baseline = AnalysisEngine(src).run(_chaos_conf(shard_retries=1))
+        trace = str(tmp_path / "serv.trace.json")
+        metrics = str(tmp_path / "serv.prom")
+        plan = FaultPlan(
+            seed=5,
+            rules=[
+                FaultRule(
+                    site="serving.job.kill", kind="error", times=1
+                )
+            ],
+        )
+        with TelemetrySession(trace_out=trace, metrics_out=metrics):
+            tier = self._tier(src, tmp_path)
+            with faults.active_plan(plan):
+                job, created = tier.submit(JobSpec(tenant="t"))
+                assert created
+                with pytest.raises(SimulatedCrash):
+                    tier.step(timeout=1.0)
+            # The "killed" tier is abandoned, as the process would be:
+            # its in-memory job is still 'running', its journal has a
+            # start event and no terminal one.
+            assert job.state == "running"
+            assert plan.fired_total == 1
+            tier2 = self._tier(src, tmp_path)
+            resumed = tier2.job(job.id)
+            assert resumed is not None and resumed.state == "queued"
+            assert tier2.step(timeout=1.0)
+            assert resumed.state == "done"
+            assert resumed.result == baseline  # exact float equality
+            tier2.close()
+        assert validate.validate_trace(trace) == []
+        assert validate.validate_metrics(metrics) == []
+        events = json.loads(open(trace).read())["traceEvents"]
+        names = {e["name"] for e in events}
+        assert {"fault_injected", "job.replay", "job.run"} <= names
+
+    def test_full_queue_sheds_instead_of_queuing_unboundedly(self):
+        from spark_examples_tpu.serving import JobSpec, QueueFullError
+
+        src = synthetic_cohort(10, 80, seed=3)
+        tier = self._tier(src, queue_depth=2)
+        tier.submit(JobSpec(tenant="a"))
+        tier.submit(JobSpec(tenant="b", num_pc=3))
+        hints = []
+        for k in (4, 5):
+            with pytest.raises(QueueFullError) as ei:
+                tier.submit(JobSpec(tenant="c", num_pc=k))
+            hints.append(ei.value.retry_after)
+        assert tier.queue_depth() == 2  # bounded, not unbounded
+        assert 0 < hints[0] < hints[1]  # backoff-shaped Retry-After
+        tier.close()
+
+    def test_tenant_quota_holds_under_concurrent_submission(self):
+        from spark_examples_tpu.serving import (
+            JobSpec,
+            QuotaExceededError,
+        )
+
+        src = synthetic_cohort(10, 80, seed=3)
+        tier = self._tier(src, queue_depth=100, tenant_quota=2)
+        n = 8
+        barrier = threading.Barrier(n)
+        outcomes = [None] * n
+
+        def submit(i):
+            barrier.wait()
+            try:
+                # Distinct analyses (different AF filters): dedup must
+                # not mask the quota.
+                tier.submit(
+                    JobSpec(
+                        tenant="greedy",
+                        min_allele_frequency=0.001 * (i + 1),
+                    )
+                )
+                outcomes[i] = "admitted"
+            except QuotaExceededError:
+                outcomes[i] = "quota"
+
+        threads = [
+            threading.Thread(target=submit, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert outcomes.count("admitted") == 2  # the quota, exactly
+        assert outcomes.count("quota") == n - 2
+        # Another tenant is unaffected by the greedy one.
+        tier.submit(JobSpec(tenant="patient"))
+        tier.close()

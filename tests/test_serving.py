@@ -1,0 +1,891 @@
+"""The analysis job tier (spark_examples_tpu/serving/).
+
+Robustness acceptance for PCA-as-a-service: admission control (bounded
+priority queue, per-tenant quotas, breaker shedding, 429 + Retry-After),
+the crash-safe job journal with deterministic replay, the result cache
+with single-flight dedup, the re-entrant engine (results bit-identical
+to the batch driver), the /analyze + /jobs HTTP surface, and the
+kill -9 service soak (slow). The deterministic kill-resume chaos
+scenarios live in tests/test_resilience.py::TestServingKillResume.
+"""
+
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.genomics.fixtures import (
+    DEFAULT_VARIANT_SET_ID,
+    synthetic_cohort,
+)
+from spark_examples_tpu.genomics.service import GenomicsServiceServer
+from spark_examples_tpu.genomics.sources import JsonlSource
+from spark_examples_tpu.obs.session import TelemetrySession
+from spark_examples_tpu.resilience import (
+    BreakerSet,
+    CircuitOpenError,
+    FaultPlan,
+    FaultRule,
+    faults,
+)
+from spark_examples_tpu.resilience.policy import RetryPolicy
+from spark_examples_tpu.serving import (
+    AnalysisEngine,
+    AnalysisJobTier,
+    JobJournal,
+    JobSpec,
+    QueueFullError,
+    QuotaExceededError,
+    cohort_key,
+)
+from spark_examples_tpu.serving.queue import AdmissionQueue
+from spark_examples_tpu.utils.config import PcaConfig
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_validator():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "validate_trace",
+        os.path.join(_REPO_ROOT, "scripts", "validate_trace.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+validate = _load_validator()
+
+REFS = "17:41196311:41277499"
+
+
+def _base_conf(**kw):
+    kw.setdefault("variant_set_ids", [DEFAULT_VARIANT_SET_ID])
+    kw.setdefault("references", REFS)
+    kw.setdefault("bases_per_partition", 20_000)
+    kw.setdefault("block_variants", 16)
+    kw.setdefault("ingest_workers", 2)
+    return PcaConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def served_source():
+    """One cohort + base config + the batch-engine baseline rows every
+    serving result must match bit-for-bit."""
+    src = synthetic_cohort(8, 60, seed=9)
+    base = _base_conf()
+    rows = AnalysisEngine(src).run(base)
+    return src, base, rows
+
+
+class TestJobSpec:
+    def test_unknown_field_is_a_loud_error(self):
+        with pytest.raises(ValueError, match="unknown spec field"):
+            JobSpec.from_record({"min_allele_freq": 0.1})
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="num_pc"):
+            JobSpec.from_record({"num_pc": 0})
+        with pytest.raises(ValueError, match="min_allele_frequency"):
+            JobSpec.from_record({"min_allele_frequency": 1.5})
+        with pytest.raises(ValueError, match="variant_set_ids"):
+            JobSpec.from_record({"variant_set_ids": [42]})
+        with pytest.raises(ValueError, match="priority"):
+            # Unbounded priority would let one tenant park above
+            # everyone else forever.
+            JobSpec.from_record({"priority": 11})
+        with pytest.raises(ValueError, match="JSON object"):
+            JobSpec.from_record([1, 2])
+
+    def test_roundtrip(self):
+        spec = JobSpec.from_record(
+            {
+                "tenant": "t",
+                "variant_set_id": "vs",
+                "num_pc": 3,
+                "priority": 5,
+            }
+        )
+        assert JobSpec.from_record(spec.to_record()) == spec
+
+    def test_cohort_key_ignores_tenant_and_priority(self):
+        base = _base_conf()
+        a = JobSpec(tenant="a", priority=1, num_pc=2)
+        b = JobSpec(tenant="b", priority=9, num_pc=2)
+        assert cohort_key(a, base) == cohort_key(b, base)
+
+    def test_cohort_key_covers_analysis_parameters(self):
+        base = _base_conf()
+        keys = {
+            cohort_key(JobSpec(num_pc=2), base),
+            cohort_key(JobSpec(num_pc=3), base),
+            cohort_key(JobSpec(min_allele_frequency=0.1), base),
+            cohort_key(JobSpec(references="17:1:1000"), base),
+            cohort_key(JobSpec(variant_set_ids=("other",)), base),
+        }
+        assert len(keys) == 5
+
+    def test_spec_inherits_server_analysis_defaults(self):
+        """An empty submission analyzes EXACTLY the cohort the server's
+        own batch run would: all_references and the AF filter inherit
+        the server config unless the client sets them."""
+        from spark_examples_tpu.serving.jobs import resolve_spec
+
+        base = _base_conf(
+            min_allele_frequency=0.05, all_references=True, num_pc=4
+        )
+        resolved = resolve_spec(JobSpec.from_record({}), base)
+        assert resolved["min_allele_frequency"] == 0.05
+        assert resolved["all_references"] is True
+        assert resolved["num_pc"] == 4
+        # An explicit client value wins over the server default.
+        resolved = resolve_spec(
+            JobSpec.from_record(
+                {"min_allele_frequency": 0.2, "all_references": False}
+            ),
+            base,
+        )
+        assert resolved["min_allele_frequency"] == 0.2
+        assert resolved["all_references"] is False
+
+    def test_cohort_key_resolves_server_defaults(self):
+        """An explicit spec equal to the defaults shares the default's
+        key — the cache must unify them."""
+        base = _base_conf()
+        assert cohort_key(JobSpec(), base) == cohort_key(
+            JobSpec(
+                variant_set_ids=(DEFAULT_VARIANT_SET_ID,),
+                references=REFS,
+            ),
+            base,
+        )
+
+
+class TestAdmissionQueue:
+    def test_priority_then_submission_order(self):
+        q = AdmissionQueue(capacity=10)
+        q.admit("low", "t", 0, 1)
+        q.admit("hi", "t", 5, 2)
+        q.admit("mid", "t", 1, 3)
+        assert [q.pop(0), q.pop(0), q.pop(0)] == ["hi", "mid", "low"]
+
+    def test_capacity_sheds_with_growing_retry_after(self):
+        q = AdmissionQueue(capacity=1, tenant_quota=10)
+        q.admit("a", "t", 0, 1)
+        hints = []
+        for seq in (2, 3, 4):
+            with pytest.raises(QueueFullError) as ei:
+                q.admit("b", "t", 0, seq)
+            hints.append(ei.value.retry_after)
+        # The hint is RetryPolicy.backoff_delay over the shed streak:
+        # deterministic (jitter=0) and growing.
+        policy = RetryPolicy(
+            base_delay=1.0, max_delay=30.0, multiplier=2.0, jitter=0.0
+        )
+        assert hints == [policy.backoff_delay(n) for n in (1, 2, 3)]
+        assert hints[0] < hints[1] < hints[2]
+
+    def test_tenant_quota_holds_and_releases_at_terminal(self):
+        q = AdmissionQueue(capacity=10, tenant_quota=2)
+        q.admit("a", "t1", 0, 1)
+        q.admit("b", "t1", 0, 2)
+        with pytest.raises(QuotaExceededError) as ei:
+            q.admit("c", "t1", 0, 3)
+        assert ei.value.retry_after > 0
+        q.admit("d", "t2", 0, 4)  # another tenant is unaffected
+        # Dequeue alone must NOT reclaim quota (the job is running)...
+        assert q.pop(0) == "a"
+        with pytest.raises(QuotaExceededError):
+            q.admit("c", "t1", 0, 5)
+        # ...terminal release does.
+        q.release("t1")
+        q.admit("c", "t1", 0, 6)
+
+    def test_readmit_bypasses_shed_checks(self):
+        q = AdmissionQueue(capacity=1, tenant_quota=1)
+        q.admit("a", "t", 0, 1)
+        q.readmit("b", "t", 0, 2)  # replayed work is never dropped
+        assert q.depth() == 2
+
+
+class TestJobJournal:
+    def test_append_replay_roundtrip(self, tmp_path):
+        d = str(tmp_path / "j")
+        j = JobJournal(d)
+        j.append({"e": "submit", "id": "x", "seq": 1})
+        j.append({"e": "done", "id": "x", "rows": [["s", 0.5, -0.25, "d"]]})
+        j.close()
+        events = list(JobJournal.replay_events(d))
+        assert [e["e"] for e in events] == ["submit", "done"]
+        assert events[1]["rows"] == [["s", 0.5, -0.25, "d"]]
+
+    def test_torn_tail_is_skipped_with_warning(self, tmp_path, capsys):
+        d = str(tmp_path / "j")
+        j = JobJournal(d)
+        j.append({"e": "submit", "id": "x", "seq": 1})
+        j.close()
+        with open(os.path.join(d, "jobs.journal.jsonl"), "ab") as f:
+            f.write(b'{"e": "start", "id"')  # SIGKILL mid-append
+        events = list(JobJournal.replay_events(d))
+        assert [e["e"] for e in events] == ["submit"]
+        assert "torn/corrupt journal line" in capsys.readouterr().err
+
+    def test_torn_write_fault_seam(self, tmp_path):
+        d = str(tmp_path / "j")
+        plan = FaultPlan(
+            seed=1,
+            rules=[
+                FaultRule(
+                    site="serving.journal.append", kind="torn", times=1
+                )
+            ],
+        )
+        j = JobJournal(d)
+        j.append({"e": "submit", "id": "a", "seq": 1})
+        with faults.active_plan(plan):
+            j.append({"e": "start", "id": "a"})  # torn: half the bytes
+        j.close()
+        assert plan.fired_total == 1
+        events = list(JobJournal.replay_events(d))
+        assert [e["e"] for e in events] == ["submit"]
+
+    def test_flush_never_blocks_on_a_wedged_writer(self, tmp_path):
+        """The fail-stop path calls flush; a writer wedged inside an
+        append (hung disk) holds the journal lock — flush must give up
+        rather than convert exit-77 into a permanent hang."""
+        import time as _time
+
+        j = JobJournal(str(tmp_path / "j"))
+        assert j._lock.acquire()  # the "wedged writer"
+        try:
+            t0 = _time.monotonic()
+            j.flush()  # must return (bounded wait), not deadlock
+            assert _time.monotonic() - t0 < 10.0
+        finally:
+            j._lock.release()
+        j.close()
+
+    def test_torn_tail_healed_on_reopen_before_appending(self, tmp_path):
+        """A reopened journal must terminate a crash-torn tail before
+        its first append — otherwise the next (acknowledged) event
+        merges into the torn line and vanishes from every replay."""
+        d = str(tmp_path / "j")
+        j = JobJournal(d)
+        j.append({"e": "submit", "id": "a", "seq": 1})
+        j.close()
+        with open(os.path.join(d, "jobs.journal.jsonl"), "ab") as f:
+            f.write(b'{"e": "start", "id"')  # SIGKILL mid-append
+        j2 = JobJournal(d)  # the restarted server's journal
+        j2.append({"e": "submit", "id": "b", "seq": 2})
+        j2.close()
+        events = list(JobJournal.replay_events(d))
+        # The torn line is skipped alone; the post-restart event
+        # survives intact.
+        assert [(e["e"], e["id"]) for e in events] == [
+            ("submit", "a"),
+            ("submit", "b"),
+        ]
+
+    def test_registers_watchdog_flush_hook(self, tmp_path):
+        from spark_examples_tpu.utils import watchdog
+
+        d = str(tmp_path / "j")
+        j = JobJournal(d)
+        name = f"job-journal:{j.path}"
+        assert name in watchdog._flush_hooks
+        j.close()
+        assert name not in watchdog._flush_hooks
+
+
+class TestTierExecution:
+    def test_job_matches_batch_driver_bit_identical(self, served_source):
+        src, base, baseline = served_source
+        tier = AnalysisJobTier(AnalysisEngine(src), base, workers=0)
+        job, created = tier.submit(JobSpec(tenant="t1"))
+        assert created and job.state == "queued"
+        assert tier.step(timeout=1.0)
+        assert job.state == "done"
+        assert job.result == baseline  # exact float equality
+        tier.close()
+
+    def test_single_flight_dedup_and_result_cache(self, served_source):
+        src, base, baseline = served_source
+        tier = AnalysisJobTier(AnalysisEngine(src), base, workers=0)
+        job, created = tier.submit(JobSpec(tenant="a"))
+        dup, dup_created = tier.submit(JobSpec(tenant="b", priority=3))
+        assert created and not dup_created
+        assert dup.id == job.id  # one execution, any number of waiters
+        # ...but the dedup response is a CALLER-SCOPED view: tenant b
+        # sees its own identity, never tenant a's record.
+        assert dup.spec.tenant == "b"
+        assert job.spec.tenant == "a"
+        tier.step(timeout=1.0)
+        # A post-completion identical submission is a cache hit: no new
+        # work, no queue traffic — and the original record is not
+        # mutated for its own submitter.
+        hit, hit_created = tier.submit(JobSpec(tenant="c"))
+        assert not hit_created and hit.state == "done" and hit.cached
+        assert hit.result == baseline
+        assert hit.spec.tenant == "c"
+        assert job.cached is False
+        assert tier.queue_depth() == 0
+        # A different analysis is NOT unified.
+        other, other_created = tier.submit(JobSpec(tenant="a", num_pc=3))
+        assert other_created and other.id != job.id
+        tier.close()
+
+    def test_failed_job_reports_and_does_not_poison_cache(
+        self, served_source
+    ):
+        src, base, _ = served_source
+        plan = FaultPlan(
+            seed=2,
+            rules=[
+                FaultRule(site="serving.job.run", kind="error", times=1)
+            ],
+        )
+        tier = AnalysisJobTier(AnalysisEngine(src), base, workers=0)
+        with faults.active_plan(plan):
+            job, _ = tier.submit(JobSpec(tenant="t"))
+            tier.step(timeout=1.0)
+        assert job.state == "failed"
+        assert "injected" in job.error
+        # The key is free again: resubmission runs fresh and succeeds.
+        retry, created = tier.submit(JobSpec(tenant="t"))
+        assert created and retry.id != job.id
+        tier.step(timeout=1.0)
+        assert retry.state == "done"
+        tier.close()
+
+    def test_breaker_opens_on_io_failing_jobs_and_sheds(
+        self, served_source
+    ):
+        src, base, _ = served_source
+        plan = FaultPlan(
+            seed=3,
+            rules=[
+                FaultRule(site="serving.job.run", kind="error", times=2)
+            ],
+        )
+        tier = AnalysisJobTier(
+            AnalysisEngine(src),
+            base,
+            workers=0,
+            breakers=BreakerSet(
+                "serving:", failure_threshold=2, cooldown_s=60.0
+            ),
+        )
+        with faults.active_plan(plan):
+            for _ in range(2):
+                job, _ = tier.submit(JobSpec(tenant="t"))
+                tier.step(timeout=1.0)
+                assert job.state == "failed"
+        # Two IO-shaped job failures crossed the threshold: the analyze
+        # endpoint now sheds submissions instantly.
+        with pytest.raises(CircuitOpenError):
+            tier.submit(JobSpec(tenant="t"))
+        tier.close()
+
+    def test_spec_error_fails_job_without_feeding_breaker(
+        self, served_source
+    ):
+        src, base, _ = served_source
+        tier = AnalysisJobTier(
+            AnalysisEngine(src),
+            base,
+            workers=0,
+            breakers=BreakerSet(
+                "serving:", failure_threshold=1, cooldown_s=60.0
+            ),
+        )
+        # A deterministic config error (bad references string) is the
+        # tier ANSWERING, not transport weather: threshold 1 must not
+        # trip.
+        job, _ = tier.submit(JobSpec(tenant="t", references="nonsense"))
+        tier.step(timeout=1.0)
+        assert job.state == "failed"
+        ok, created = tier.submit(JobSpec(tenant="t"))
+        assert created  # no CircuitOpenError
+        tier.close()
+
+    def test_journal_unavailable_sheds_and_rolls_back(
+        self, served_source, tmp_path
+    ):
+        """A submission the journal cannot record must not run (it
+        would vanish from resume): the admission rolls back, the client
+        sheds retryably (429 reason=journal over HTTP), and neither
+        quota nor the dedup table leaks."""
+        from spark_examples_tpu.serving import JournalUnavailableError
+
+        src, base, baseline = served_source
+        plan = FaultPlan(
+            seed=4,
+            rules=[
+                FaultRule(
+                    site="serving.journal.append", kind="error", times=1
+                )
+            ],
+        )
+        tier = AnalysisJobTier(
+            AnalysisEngine(src),
+            base,
+            workers=0,
+            tenant_quota=1,
+            journal_dir=str(tmp_path / "journal"),
+        )
+        with faults.active_plan(plan):
+            with pytest.raises(JournalUnavailableError) as ei:
+                tier.submit(JobSpec(tenant="t"))
+        assert ei.value.retry_after > 0
+        assert tier.jobs() == []  # rolled back, not half-admitted
+        assert tier.queue_depth() == 0  # no phantom heap entry either
+        # Quota slot returned: the SAME tenant resubmits successfully
+        # (quota is 1 — a leak would shed here) and the job runs.
+        job, created = tier.submit(JobSpec(tenant="t"))
+        assert created
+        assert tier.step(timeout=1.0)
+        assert job.state == "done" and job.result == baseline
+        # The journal carries only the second (recorded) submission.
+        tier.close()
+        events = list(JobJournal.replay_events(str(tmp_path / "journal")))
+        assert [e["e"] for e in events] == ["submit", "start", "done"]
+
+    def test_terminal_jobs_evicted_beyond_retention(self, served_source):
+        """The in-memory job table is bounded: oldest terminal jobs
+        evict past the retention limit (a week of traffic must not
+        become the OOM the admission queue exists to prevent)."""
+        src, base, _ = served_source
+        tier = AnalysisJobTier(
+            AnalysisEngine(src), base, workers=0, job_retention=2
+        )
+        jobs = []
+        for i in range(4):
+            job, _ = tier.submit(JobSpec(tenant="t", num_pc=2 + i))
+            tier.step(timeout=1.0)
+            jobs.append(job)
+        assert all(j.state == "done" for j in jobs)
+        kept = {j.id for j in tier.jobs()}
+        assert kept == {jobs[2].id, jobs[3].id}  # newest two survive
+        # An evicted analysis is still served by the result cache.
+        hit, created = tier.submit(JobSpec(tenant="x", num_pc=2))
+        assert not created and hit.cached
+        tier.close()
+
+    def test_failed_job_reclaims_its_checkpoint_dir(
+        self, served_source, tmp_path
+    ):
+        src, base, _ = served_source
+        plan = FaultPlan(
+            seed=9,
+            rules=[
+                FaultRule(site="serving.job.run", kind="error", times=1)
+            ],
+        )
+        journal = str(tmp_path / "journal")
+        tier = AnalysisJobTier(
+            AnalysisEngine(src), base, workers=0, journal_dir=journal
+        )
+        with faults.active_plan(plan):
+            job, _ = tier.submit(JobSpec(tenant="t"))
+            tier.step(timeout=1.0)
+        assert job.state == "failed"
+        assert not os.path.exists(
+            os.path.join(journal, "ckpt", job.id)
+        )
+        tier.close()
+
+    def test_worker_threads_drain_the_queue(self, served_source):
+        src, base, baseline = served_source
+        tier = AnalysisJobTier(
+            AnalysisEngine(src), base, workers=2
+        ).start()
+        jobs = [
+            tier.submit(JobSpec(tenant=f"t{i}", num_pc=2 + i))[0]
+            for i in range(3)
+        ]
+        deadline = time.time() + 120
+        while time.time() < deadline and any(
+            j.state not in ("done", "failed") for j in jobs
+        ):
+            time.sleep(0.05)
+        assert [j.state for j in jobs] == ["done"] * 3
+        # num_pc=2 job matches the baseline exactly even when executed
+        # concurrently with others — the engine shares nothing mutable.
+        assert jobs[0].result == baseline
+        tier.close()
+
+    def test_telemetry_artifacts_carry_the_job_story(
+        self, served_source, tmp_path
+    ):
+        src, base, _ = served_source
+        trace = str(tmp_path / "serv.trace.json")
+        metrics = str(tmp_path / "serv.prom")
+        with TelemetrySession(trace_out=trace, metrics_out=metrics):
+            tier = AnalysisJobTier(
+                AnalysisEngine(src),
+                base,
+                workers=0,
+                queue_depth=2,
+                tenant_quota=1,
+                journal_dir=str(tmp_path / "journal"),
+            )
+            tier.submit(JobSpec(tenant="a"))
+            with pytest.raises(QuotaExceededError):
+                tier.submit(JobSpec(tenant="a", num_pc=3))
+            tier.submit(JobSpec(tenant="b", num_pc=3))  # queue now full
+            with pytest.raises(QueueFullError):
+                tier.submit(JobSpec(tenant="c", num_pc=4))
+            tier.step(timeout=1.0)
+            tier.step(timeout=1.0)
+            tier.submit(JobSpec(tenant="c"))  # cache hit
+            tier.close()
+            # Restart replays the journal under the same session: the
+            # job.replay span lands on the same timeline.
+            tier2 = AnalysisJobTier(
+                AnalysisEngine(src),
+                base,
+                workers=0,
+                journal_dir=str(tmp_path / "journal"),
+            )
+            tier2.close()
+        assert validate.validate_trace(trace) == []
+        assert validate.validate_metrics(metrics) == []
+        events = json.loads(open(trace).read())["traceEvents"]
+        names = {e["name"] for e in events}
+        assert {"job.run", "job.replay", "job_transition", "job_shed"} <= names
+        # Queue depth rides the timeline as a counter track too.
+        assert any(
+            e["ph"] == "C" and e["name"] == "serving_queue_depth"
+            for e in events
+        )
+        prom = open(metrics).read()
+        assert 'serving_jobs_total{outcome="done"}' in prom
+        assert 'serving_jobs_total{outcome="cached"}' in prom
+        assert 'serving_shed_total{reason="queue_full"}' in prom
+        assert 'serving_shed_total{reason="quota"}' in prom
+        assert "serving_queue_depth" in prom
+
+
+def _post(conn, path, doc):
+    conn.request(
+        "POST",
+        path,
+        body=json.dumps(doc),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    return resp.status, dict(resp.getheaders()), json.loads(resp.read())
+
+
+def _get(conn, path):
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    return resp.status, (json.loads(body) if body.startswith(b"{") else None)
+
+
+class TestAnalyzeHttp:
+    def test_submit_poll_result_parity(self, served_source):
+        src, base, baseline = served_source
+        tier = AnalysisJobTier(AnalysisEngine(src), base, workers=1).start()
+        server = GenomicsServiceServer(src, job_tier=tier).start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=60
+            )
+            st, _, doc = _post(conn, "/analyze", {"tenant": "lab"})
+            assert st == 202 and doc["state"] == "queued"
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                st, jd = _get(conn, f"/jobs/{doc['id']}")
+                if jd["state"] in ("done", "failed"):
+                    break
+                time.sleep(0.05)
+            assert jd["state"] == "done"
+            # JSON float round-trip is exact (repr): the HTTP result is
+            # bit-identical to the batch driver's rows.
+            got = [tuple(r) for r in jd["result"]]
+            np.testing.assert_array_equal(
+                np.array([[r[1], r[2]] for r in got]),
+                np.array([[r[1], r[2]] for r in baseline]),
+            )
+            assert [r[0] for r in got] == [r[0] for r in baseline]
+            # Identical resubmission: served without new work (200).
+            st, _, doc2 = _post(conn, "/analyze", {"tenant": "other"})
+            assert st == 200 and doc2["state"] == "done"
+            st, lst = _get(conn, "/jobs")
+            assert len(lst["jobs"]) == 1
+        finally:
+            server.stop()
+            tier.close()
+
+    def test_queue_full_and_quota_shed_429_retry_after(
+        self, served_source
+    ):
+        src, base, _ = served_source
+        tier = AnalysisJobTier(
+            AnalysisEngine(src),
+            base,
+            workers=0,  # nothing drains: shedding is deterministic
+            queue_depth=2,
+            tenant_quota=1,
+        )
+        server = GenomicsServiceServer(src, job_tier=tier).start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=30
+            )
+            st, _, _ = _post(conn, "/analyze", {"tenant": "t1"})
+            assert st == 202
+            # Tenant quota (the queue still has room for other tenants).
+            st, hdr, doc = _post(
+                conn, "/analyze", {"tenant": "t1", "num_pc": 3}
+            )
+            assert st == 429 and doc["reason"] == "quota"
+            assert int(hdr["Retry-After"]) >= 1
+            st, _, _ = _post(conn, "/analyze", {"tenant": "t2", "num_pc": 4})
+            assert st == 202
+            # Queue capacity: full now, sheds regardless of tenant.
+            st, hdr, doc = _post(
+                conn, "/analyze", {"tenant": "t3", "num_pc": 5}
+            )
+            assert st == 429 and doc["reason"] == "queue_full"
+            assert int(hdr["Retry-After"]) >= 1
+        finally:
+            server.stop()
+            tier.close()
+
+    def test_oversized_body_is_refused_before_buffering(
+        self, served_source
+    ):
+        """An unauthenticated client must not be able to buy server
+        memory with a huge Content-Length: the cap refuses with 413
+        before any body bytes are buffered."""
+        import socket
+
+        src, base, _ = served_source
+        tier = AnalysisJobTier(AnalysisEngine(src), base, workers=0)
+        server = GenomicsServiceServer(
+            src, token="sekrit", job_tier=tier
+        ).start()
+        try:
+            s = socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            )
+            s.sendall(
+                b"POST /analyze HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: 8000000000\r\n\r\n"
+            )
+            status = s.recv(4096).decode().splitlines()[0]
+            assert " 413 " in status
+            s.close()
+            # A body of UNKNOWN length is refused too: chunked framing
+            # read as "no body" would silently run the default analysis
+            # instead of the client's spec.
+            s = socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            )
+            s.sendall(
+                b"POST /analyze HTTP/1.1\r\nHost: x\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+                b"2\r\n{}\r\n0\r\n\r\n"
+            )
+            status = s.recv(4096).decode().splitlines()[0]
+            assert " 501 " in status
+            s.close()
+            s = socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            )
+            s.sendall(b"POST /analyze HTTP/1.1\r\nHost: x\r\n\r\n")
+            status = s.recv(4096).decode().splitlines()[0]
+            assert " 411 " in status
+            s.close()
+        finally:
+            server.stop()
+            tier.close()
+
+    def test_bad_spec_400_unknown_job_404_no_tier_404(self, served_source):
+        src, base, _ = served_source
+        tier = AnalysisJobTier(AnalysisEngine(src), base, workers=0)
+        server = GenomicsServiceServer(src, job_tier=tier).start()
+        bare = GenomicsServiceServer(src).start()  # no tier
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=30
+            )
+            st, _, doc = _post(conn, "/analyze", {"bogus": True})
+            assert st == 400 and "unknown spec field" in doc["error"]
+            st, _ = _get(conn, "/jobs/never-submitted")
+            assert st == 404
+            conn2 = http.client.HTTPConnection(
+                "127.0.0.1", bare.port, timeout=30
+            )
+            conn2.request("POST", "/analyze", body=b"{}")
+            assert conn2.getresponse().status == 404
+        finally:
+            bare.stop()
+            server.stop()
+            tier.close()
+
+    def test_token_auth_guards_the_job_surface(self, served_source):
+        src, base, _ = served_source
+        tier = AnalysisJobTier(AnalysisEngine(src), base, workers=0)
+        server = GenomicsServiceServer(
+            src, token="sekrit", job_tier=tier
+        ).start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=30
+            )
+            conn.request("POST", "/analyze", body=b"{}")
+            resp = conn.getresponse()
+            resp.read()  # drain: the keep-alive socket stays reusable
+            assert resp.status == 401
+            conn.request(
+                "POST",
+                "/analyze",
+                body=b"{}",
+                headers={"Authorization": "Bearer sekrit"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 202
+        finally:
+            server.stop()
+            tier.close()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_http(port, path="/callsets", timeout=120.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=5
+            )
+            conn.request("GET", path)
+            conn.getresponse().read()
+            return conn
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"service on :{port} never came up")
+
+
+@pytest.mark.slow
+class TestServiceChaosSoak:
+    """The service-mode soak: submit / kill -9 / restart / resume, each
+    iteration asserting the resumed result is bit-identical to the
+    uninterrupted in-process baseline. scripts/chaos_soak.sh runs this
+    (SERVICE_SOAK_ITERS) next to the randomized ingest soak."""
+
+    def test_kill9_restart_resume_loop(self, tmp_path):
+        iters = int(os.environ.get("SERVICE_SOAK_ITERS", "2"))
+        root = str(tmp_path / "cohort")
+        synthetic_cohort(10, 400, seed=7).dump(root)
+        journal = str(tmp_path / "journal")
+        base = _base_conf()
+        baselines = {}
+
+        def serve(port):
+            return subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "spark_examples_tpu.cli.main",
+                    "serve-cohort",
+                    "--input-path",
+                    root,
+                    "--references",
+                    REFS,
+                    "--bases-per-partition",
+                    "20000",
+                    "--block-variants",
+                    "16",
+                    "--port",
+                    str(port),
+                    "--analyze",
+                    "--analyze-workers",
+                    "1",
+                    "--analyze-journal-dir",
+                    journal,
+                ],
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+
+        for i in range(iters):
+            spec = {"tenant": "soak", "num_pc": 2 + i}
+            conf = PcaConfig(
+                **{
+                    **base.__dict__,
+                    "num_pc": 2 + i,
+                    "input_path": None,
+                }
+            )
+            key = (2 + i,)
+            if key not in baselines:
+                baselines[key] = AnalysisEngine(JsonlSource(root)).run(
+                    conf
+                )
+            port = _free_port()
+            proc = serve(port)
+            jid = None
+            try:
+                conn = _wait_http(port)
+                st, _, doc = _post(conn, "/analyze", spec)
+                assert st == 202, doc
+                jid = doc["id"]
+                # Kill as soon as the job leaves the queue — a SIGKILL
+                # mid-run, start journaled, no terminal event.
+                deadline = time.time() + 120
+                while time.time() < deadline:
+                    st, jd = _get(conn, f"/jobs/{jid}")
+                    if jd["state"] in ("running", "done"):
+                        break
+                    time.sleep(0.02)
+            finally:
+                proc.send_signal(signal.SIGKILL)
+                proc.wait(timeout=30)
+            # Restart over the same journal: replay re-queues (or
+            # re-serves) the job; the result must be bit-identical to
+            # the uninterrupted run.
+            port = _free_port()
+            proc = serve(port)
+            try:
+                conn = _wait_http(port)
+                deadline = time.time() + 240
+                jd = None
+                while time.time() < deadline:
+                    st, jd = _get(conn, f"/jobs/{jid}")
+                    assert st == 200, f"job {jid} lost across restart"
+                    if jd["state"] in ("done", "failed"):
+                        break
+                    time.sleep(0.1)
+                assert jd and jd["state"] == "done", jd
+                got = [tuple(r) for r in jd["result"]]
+                want = baselines[key]
+                assert [r[0] for r in got] == [r[0] for r in want]
+                np.testing.assert_array_equal(
+                    np.array([[r[1], r[2]] for r in got]),
+                    np.array([[r[1], r[2]] for r in want]),
+                )
+            finally:
+                proc.terminate()
+                proc.wait(timeout=30)

@@ -155,8 +155,9 @@ class SpanContractRule:
     name = NAME
     code = CODE
     summary = (
-        "spans are context-managed; ingest.* span names and wire/ingest "
-        "metric registrations match scripts/validate_trace.py exactly"
+        "spans are context-managed; ingest.*/job.* span names and "
+        "wire/ingest/serving metric registrations match "
+        "scripts/validate_trace.py exactly"
     )
     project_wide = True
 
@@ -182,42 +183,46 @@ class SpanContractRule:
                                 "nesting",
                             )
                         )
-        # 2-3. Name-set cross-check against the runtime schema.
+        # 2-3. Name-set cross-check against the runtime schema — the
+        # same closed-set discipline for each prefixed span family
+        # (ingest sub-phases, serving job tier).
         schema = load_schema(project.root)
         if schema is None:
             return findings
         span_names = extract_span_names(project)
-        ingest_emitted = {
-            n for n in span_names if n.startswith("ingest.")
-        }
-        schema_spans: Set[str] = set(
-            getattr(schema, "_INGEST_SPANS", set())
-        )
-        for name in sorted(ingest_emitted - schema_spans):
-            rel, line = span_names[name][0]
-            findings.append(
-                Finding(
-                    NAME,
-                    CODE,
-                    rel,
-                    line,
-                    f"span {name!r} is not in validate_trace._INGEST_SPANS"
-                    " — artifacts carrying it fail the runtime schema "
-                    "gate; add it to the schema in the same change",
+        for prefix, attr in (
+            ("ingest.", "_INGEST_SPANS"),
+            ("job.", "_JOB_SPANS"),
+        ):
+            emitted = {n for n in span_names if n.startswith(prefix)}
+            schema_spans: Set[str] = set(getattr(schema, attr, set()))
+            for name in sorted(emitted - schema_spans):
+                rel, line = span_names[name][0]
+                findings.append(
+                    Finding(
+                        NAME,
+                        CODE,
+                        rel,
+                        line,
+                        f"span {name!r} is not in validate_trace."
+                        f"{attr} — artifacts carrying it fail the "
+                        "runtime schema gate; add it to the schema in "
+                        "the same change",
+                    )
                 )
-            )
-        for name in sorted(schema_spans - ingest_emitted):
-            findings.append(
-                Finding(
-                    NAME,
-                    CODE,
-                    SCHEMA_SCRIPT,
-                    _schema_line(project, f'"{name}"'),
-                    f"schema span {name!r} is emitted nowhere in the "
-                    "tree (literal scan) — dead schema entries hide "
-                    "renames; remove it or restore the emission",
+            for name in sorted(schema_spans - emitted):
+                findings.append(
+                    Finding(
+                        NAME,
+                        CODE,
+                        SCHEMA_SCRIPT,
+                        _schema_line(project, f'"{name}"'),
+                        f"schema span {name!r} is emitted nowhere in "
+                        "the tree (literal scan) — dead schema entries "
+                        "hide renames; remove it or restore the "
+                        "emission",
+                    )
                 )
-            )
         # 4-5. Metric contract: required names registered, with the
         # labels the schema's sample checks demand.
         regs = extract_metric_registrations(project)
@@ -233,6 +238,9 @@ class SpanContractRule:
         ingest_hist = getattr(schema, "_INGEST_HISTOGRAM", None)
         if ingest_hist:
             required[ingest_hist] = "mode"
+        # Serving/resilience counters: the schema names the label each
+        # sample must carry (breaker probes, job outcomes, sheds).
+        required.update(getattr(schema, "_LABELED_COUNTERS", {}))
         for name, label in sorted(required.items()):
             sites = regs.get(name)
             if not sites:
