@@ -141,24 +141,27 @@ def _make_handler(source, token: Optional[str], job_tier=None):
 
         def _handle_jobs_get(self, path: str) -> None:
             # The job tier's read surface: /jobs lists, /jobs/<id>
-            # fetches one (result rows included when done).
+            # fetches one (result rows included when done). Records are
+            # serialized UNDER the tier lock (job_records/job_record):
+            # workers mutate Job state/result/error under that lock,
+            # and a lock-free to_record() here could serve a torn
+            # transition — state flipped, result not yet attached.
             if path == "/jobs":
                 self._send_json(
                     200,
                     {
-                        "jobs": [
-                            j.to_record(include_result=False)
-                            for j in job_tier.jobs()
-                        ],
+                        "jobs": job_tier.job_records(
+                            include_result=False
+                        ),
                         "queue_depth": job_tier.queue_depth(),
                     },
                 )
                 return
-            job = job_tier.job(path[len("/jobs/"):])
-            if job is None:
+            rec = job_tier.job_record(path[len("/jobs/"):])
+            if rec is None:
                 self.send_error(404, "no such job")
                 return
-            self._send_json(200, job.to_record())
+            self._send_json(200, rec)
 
         def do_POST(self):  # noqa: N802 — http.server API
             # Drain the body FIRST, whatever the outcome: unread body
@@ -248,7 +251,11 @@ def _make_handler(source, token: Optional[str], job_tier=None):
                     retry_after=e.retry_in,
                 )
                 return
-            self._send_json(202 if created else 200, job.to_record())
+            # record_of: a worker may already be finishing this job on
+            # another thread — serialize it under the tier lock too.
+            self._send_json(
+                202 if created else 200, job_tier.record_of(job)
+            )
 
         def _authorized(self) -> bool:
             if token is None:

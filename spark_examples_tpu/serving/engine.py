@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import collections
 import threading
-from typing import List, Tuple
+from typing import Any, List, Tuple
 
 __all__ = ["AnalysisEngine"]
 
@@ -41,7 +41,7 @@ _INDEX_CACHE_SIZE = 8
 class AnalysisEngine:
     """Runs PCA jobs against one resident source (one per server)."""
 
-    def __init__(self, source, mesh=None) -> None:
+    def __init__(self, source: Any, mesh: Any = None) -> None:
         self.source = source
         self.mesh = mesh
         # One chip owner at a time — see the module docstring.
@@ -51,7 +51,7 @@ class AnalysisEngine:
             collections.OrderedDict()
         )
 
-    def index_for(self, variant_set_ids: Tuple[str, ...]):
+    def index_for(self, variant_set_ids: Tuple[str, ...]) -> Any:
         """The shared immutable CallsetIndex for a variantset tuple
         (LRU-bounded; callset listings don't change under a resident
         cohort — a swapped cohort is a server restart). Order matters
@@ -72,7 +72,7 @@ class AnalysisEngine:
                 self._indexes.popitem(last=False)
             return index
 
-    def run(self, conf) -> List[Tuple[str, float, float, str]]:
+    def run(self, conf: Any) -> List[Tuple[str, float, float, str]]:
         """Execute one job: fresh driver, shared index, serialized
         device phases → ``(name, pc1, pc2, dataset)`` rows."""
         from spark_examples_tpu.models.pca import VariantsPcaDriver

@@ -148,7 +148,7 @@ class JobSpec:
         }
 
 
-def resolve_spec(spec: JobSpec, base) -> Dict[str, Any]:
+def resolve_spec(spec: JobSpec, base: Any) -> Dict[str, Any]:
     """The spec with server defaults applied — the EXACT parameter set a
     job will run with, which is therefore what the cohort key must
     cover (``base`` is the server's PcaConfig template)."""
@@ -177,7 +177,7 @@ def resolve_spec(spec: JobSpec, base) -> Dict[str, Any]:
     }
 
 
-def cohort_key(spec: JobSpec, base) -> str:
+def cohort_key(spec: JobSpec, base: Any) -> str:
     """Hex result-cache key: murmur3_x64_128 over the canonical JSON of
     the resolved analysis parameters. Tenant and priority are excluded
     ON PURPOSE — identical analyses share results across tenants (the
@@ -190,7 +190,9 @@ def cohort_key(spec: JobSpec, base) -> str:
     return murmur3_x64_128(payload).hex()
 
 
-def job_config(spec: JobSpec, base, checkpoint_dir: Optional[str] = None):
+def job_config(
+    spec: JobSpec, base: Any, checkpoint_dir: Optional[str] = None
+) -> Any:
     """Per-job PcaConfig: the server template with the spec's analysis
     parameters applied and every emission/telemetry output stripped
     (jobs return rows; they never write the operator's artifacts)."""

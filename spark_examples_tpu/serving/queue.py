@@ -26,6 +26,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from spark_examples_tpu.resilience.policy import RetryPolicy
+from spark_examples_tpu.utils.lockcheck import assert_lock_held
 
 __all__ = [
     "AdmissionError",
@@ -54,7 +55,7 @@ class AdmissionError(RuntimeError):
 
     reason = "shed"
 
-    def __init__(self, message: str, retry_after: float):
+    def __init__(self, message: str, retry_after: float) -> None:
         super().__init__(message)
         self.retry_after = retry_after
 
@@ -111,6 +112,7 @@ class AdmissionQueue:
     # -- observability --------------------------------------------------------
 
     def _note_depth_locked(self) -> None:
+        assert_lock_held(self._cv, "AdmissionQueue._note_depth_locked")
         from spark_examples_tpu import obs
         from spark_examples_tpu.obs.tracer import collection_active
 
@@ -127,13 +129,16 @@ class AdmissionQueue:
     # -- admission ------------------------------------------------------------
 
     def _retry_after_locked(self) -> float:
+        assert_lock_held(self._cv, "AdmissionQueue._retry_after_locked")
         # The streak grows the hint: a client hammering a saturated
         # queue is told to back off exponentially, exactly as the retry
         # engine itself would pace attempts (RetryPolicy.backoff_delay).
         self._shed_streak += 1
         return self._policy.backoff_delay(self._shed_streak)
 
-    def admit(self, job, tenant: str, priority: int, seq: int) -> None:
+    def admit(
+        self, job: object, tenant: str, priority: int, seq: int
+    ) -> None:
         """Accept ``job`` or raise a shed error with a retry_after hint.
 
         Raises :class:`QueueFullError` at capacity and
@@ -161,14 +166,19 @@ class AdmissionQueue:
             self._shed_streak = 0
             self._push_locked(job, tenant, priority, seq)
 
-    def readmit(self, job, tenant: str, priority: int, seq: int) -> None:
+    def readmit(
+        self, job: object, tenant: str, priority: int, seq: int
+    ) -> None:
         """Re-queue a journal-replayed job, bypassing the shed checks —
         the job was already admitted by the crashed server, and resume
         must never drop work that admission accepted."""
         with self._cv:
             self._push_locked(job, tenant, priority, seq)
 
-    def _push_locked(self, job, tenant: str, priority: int, seq: int) -> None:
+    def _push_locked(
+        self, job: object, tenant: str, priority: int, seq: int
+    ) -> None:
+        assert_lock_held(self._cv, "AdmissionQueue._push_locked")
         heapq.heappush(self._heap, (-priority, seq, job))
         self._in_flight[tenant] = self._in_flight.get(tenant, 0) + 1
         self._note_depth_locked()
@@ -176,7 +186,7 @@ class AdmissionQueue:
 
     # -- consumption ----------------------------------------------------------
 
-    def pop(self, timeout: Optional[float] = None):
+    def pop(self, timeout: Optional[float] = None) -> Optional[object]:
         """Next job by (priority desc, seq asc); None on timeout."""
         with self._cv:
             if not self._heap:
@@ -188,13 +198,16 @@ class AdmissionQueue:
             return job
 
     def _release_tenant_locked(self, tenant: str) -> None:
+        assert_lock_held(
+            self._cv, "AdmissionQueue._release_tenant_locked"
+        )
         n = self._in_flight.get(tenant, 0)
         if n <= 1:
             self._in_flight.pop(tenant, None)
         else:
             self._in_flight[tenant] = n - 1
 
-    def discard(self, job, tenant: str) -> bool:
+    def discard(self, job: object, tenant: str) -> bool:
         """Remove a rolled-back admission: drop its heap entry (a
         phantom must not consume capacity or inflate the depth gauge)
         and return its tenant slot. False when a worker already popped

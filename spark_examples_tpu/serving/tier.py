@@ -38,7 +38,7 @@ import collections
 import shutil
 import sys
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from spark_examples_tpu.serving.jobs import (
     JOB_DONE,
@@ -56,6 +56,7 @@ from spark_examples_tpu.serving.queue import (
     DEFAULT_QUEUE_DEPTH,
     DEFAULT_TENANT_QUOTA,
 )
+from spark_examples_tpu.utils.lockcheck import assert_lock_held
 
 __all__ = [
     "AnalysisJobTier",
@@ -126,14 +127,14 @@ class AnalysisJobTier:
 
     def __init__(
         self,
-        engine,
-        base_config,
+        engine: Any,
+        base_config: Any,
         queue_depth: int = DEFAULT_QUEUE_DEPTH,
         tenant_quota: int = DEFAULT_TENANT_QUOTA,
         workers: int = 1,
         journal_dir: Optional[str] = None,
         cache_size: int = DEFAULT_RESULT_CACHE,
-        breakers=None,
+        breakers: Any = None,
         job_retention: int = DEFAULT_JOB_RETENTION,
     ) -> None:
         from spark_examples_tpu.resilience import BreakerSet
@@ -311,6 +312,42 @@ class AnalysisJobTier:
         with self._lock:
             return sorted(self._jobs.values(), key=lambda j: j.seq)
 
+    # -- snapshot serialization ------------------------------------------------
+    #
+    # Job objects are MUTATED by workers under the tier lock; readers
+    # that serialize them must hold the same lock or they can observe a
+    # torn transition (state flipped, error/result not yet written).
+    # The HTTP surface reads ONLY through these three methods — the
+    # fix for exactly that race, pinned by a regression test.
+
+    def record_of(self, job: Job, include_result: bool = True) -> Dict:
+        """One job serialized atomically (for a Job already in hand —
+        the 202 response to a fresh submission, which a worker may
+        already be finishing)."""
+        with self._lock:
+            return job.to_record(include_result=include_result)
+
+    def job_record(
+        self, job_id: str, include_result: bool = True
+    ) -> Optional[Dict]:
+        """Lookup + serialization as one atomic step (GET /jobs/<id>)."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return None
+            return job.to_record(include_result=include_result)
+
+    def job_records(self, include_result: bool = False) -> List[Dict]:
+        """Every known job serialized under one lock hold — the /jobs
+        listing is a consistent snapshot, not a mid-transition blur."""
+        with self._lock:
+            return [
+                j.to_record(include_result=include_result)
+                for j in sorted(
+                    self._jobs.values(), key=lambda j: j.seq
+                )
+            ]
+
     def queue_depth(self) -> int:
         return self._queue.depth()
 
@@ -452,9 +489,12 @@ class AnalysisJobTier:
 
         with self._lock:
             if error is None:
-                # Result BEFORE state: HTTP readers serialize the job
-                # outside this lock, checking state first — they must
-                # never observe a result-less 'done'.
+                # Result BEFORE state: the HTTP surface serializes
+                # under this lock (record_of/job_record/job_records),
+                # but in-process callers holding a Job from job()/
+                # jobs() may still read its fields lock-free, checking
+                # state first — they must never observe a result-less
+                # 'done'.
                 job.result = rows
                 job.state = JOB_DONE
                 self._cache.put(job.key, job.id, rows)
@@ -483,6 +523,9 @@ class AnalysisJobTier:
         """Evict the oldest terminal jobs beyond the retention bound
         (active jobs are never evicted; recent results stay reachable
         through the LRU cache and the journal regardless)."""
+        assert_lock_held(
+            self._lock, "AnalysisJobTier._prune_terminal_locked"
+        )
         terminal = [
             j
             for j in self._jobs.values()
@@ -501,10 +544,17 @@ class AnalysisJobTier:
         """Rebuild state from the journal: done/failed jobs restore the
         queryable table (+ warm cache); queued/running jobs re-queue in
         original submission order — the deterministic resume a killed
-        server owes its clients."""
+        server owes its clients.
+
+        Runs under the tier lock even though it is called from
+        ``__init__`` before any worker exists: the lock-discipline the
+        static gate proves (GL007/GL009) is uniform, not "except during
+        construction" — and a future caller replaying into a LIVE tier
+        would otherwise inherit a silent race instead of a queued one.
+        """
         from spark_examples_tpu import obs
 
-        with obs.span("job.replay", journal=self._journal.path):
+        with self._lock, obs.span("job.replay", journal=self._journal.path):
             events = list(JobJournal.replay_events(self._journal_dir))
             for e in events:
                 kind = e.get("e")

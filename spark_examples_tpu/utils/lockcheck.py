@@ -1,0 +1,51 @@
+"""Runtime backstop for the ``*_locked`` convention.
+
+GL007 proves the convention statically for in-tree call sites, but a
+static gate cannot see dynamic dispatch, monkeypatched tests, or an
+embedder driving the tier directly. With
+``SPARK_EXAMPLES_TPU_LOCK_CHECK=1`` every ``*_locked`` method asserts
+its precondition on entry — a cheap owner/held probe — so a discipline
+violation fails loudly at the exact broken call site instead of
+surfacing as a torn data structure minutes later. The serving and
+resilience test suites enable it for their whole run.
+
+Disabled (the default) this is one dict lookup per call — nothing on
+any hot path anyway, since ``*_locked`` methods live on admission and
+bookkeeping code, not in kernels.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+__all__ = ["LOCK_CHECK_ENV", "lock_check_enabled", "assert_lock_held"]
+
+LOCK_CHECK_ENV = "SPARK_EXAMPLES_TPU_LOCK_CHECK"
+
+
+def lock_check_enabled() -> bool:
+    """Read per call (not cached): test fixtures toggle the env var
+    around individual suites."""
+    return os.environ.get(LOCK_CHECK_ENV, "") not in ("", "0")
+
+
+def assert_lock_held(lock: Any, what: str = "") -> None:
+    """Assert the calling thread satisfies a ``*_locked`` precondition.
+
+    RLock and Condition expose ``_is_owned()`` (CPython implementation
+    detail, but stable since 2.x) — the precise check: held BY THIS
+    THREAD. A plain Lock has no owner concept; ``locked()`` (held by
+    somebody) is the best cheap probe and still catches the common bug
+    of calling with no lock at all.
+    """
+    if not lock_check_enabled():
+        return
+    is_owned = getattr(lock, "_is_owned", None)
+    held = bool(is_owned()) if callable(is_owned) else lock.locked()
+    if not held:
+        raise AssertionError(
+            f"*_locked convention violated: {what or 'callee'} requires "
+            f"its owning lock ({lock!r}) to be held by the caller — "
+            "see docs/CONCURRENCY.md"
+        )

@@ -40,6 +40,9 @@ SINGLE_FILE_RULES = [
     ("gl003", "span-contract", ".py"),
     ("gl005", "resilience-routing", ".py"),
     ("gl006", "native-gil", ".cpp"),
+    ("gl007", "lock-discipline", ".py"),
+    ("gl008", "deadlock-order", ".py"),
+    ("gl009", "guarded-fields", ".py"),
 ]
 
 
@@ -191,7 +194,7 @@ class TestRealTreeGate:
         # The deliberate session-root suppression is visible data:
         assert objs[-1]["summary"]["suppressed"].get("span-contract", 0) >= 1
 
-    def test_list_rules_names_all_six(self):
+    def test_list_rules_names_all_nine(self):
         proc = subprocess.run(
             [sys.executable, "-m", "tools.graftlint", "--list-rules"],
             capture_output=True,
@@ -199,8 +202,35 @@ class TestRealTreeGate:
             cwd=REPO_ROOT,
         )
         assert proc.returncode == 0
-        for code in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006"):
+        for code in (
+            "GL001",
+            "GL002",
+            "GL003",
+            "GL004",
+            "GL005",
+            "GL006",
+            "GL007",
+            "GL008",
+            "GL009",
+        ):
             assert code in proc.stdout
+
+    def test_self_lint_is_clean(self):
+        """The analyzer holds itself to its own concurrency bar: the
+        CI self-lint leg (`python -m tools.graftlint tools/graftlint`)
+        exits 0."""
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "tools.graftlint",
+                "tools/graftlint",
+            ],
+            capture_output=True,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 class TestSchemaSharing:

@@ -1,0 +1,503 @@
+"""The flow-sensitive graftlint layer (PR 7): CFG construction,
+reaching-locks dataflow, the GL007/GL008/GL009 rules beyond their
+golden fixtures, and the docs/CONCURRENCY.md lock-hierarchy drift gate.
+
+The golden fixtures in tests/test_graftlint.py prove each rule's
+headline behavior; this file drills the ENGINE — the CFG shapes
+(try/finally, early return, nested with, loops, with-unwind on
+exceptions) whose mis-modeling would make every rule silently wrong
+in exactly the code most worth checking.
+"""
+
+import ast
+import json
+import os
+import re
+import textwrap
+
+from tools.graftlint.cfg import build_cfg
+from tools.graftlint.dataflow import (
+    held_at_nodes,
+    is_lock_name,
+    make_resolver,
+    node_scan_roots,
+)
+from tools.graftlint.engine import Project, load_config, run_lint
+from tools.graftlint.rules.deadlock_order import lock_graph
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fn(src: str) -> ast.AST:
+    tree = ast.parse(textwrap.dedent(src))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            return node
+    raise AssertionError("no function in snippet")
+
+
+def _held_at_line(src: str, lineno: int, must=True, seed=frozenset()):
+    """Locks held entering the statement at ``lineno`` (1-based within
+    the dedented snippet)."""
+    fn = _fn(src)
+    resolve = make_resolver("C", "mod")
+    cfg = build_cfg(fn, resolve)
+    states = held_at_nodes(cfg, resolve, seed=seed, must=must)
+    hits = []
+    for node, held in states.items():
+        if node.kind == "stmt" and node.line == lineno:
+            hits.append(held)
+    assert hits, f"no stmt node at line {lineno}"
+    # Several nodes can share a line (e.g. compound headers); for these
+    # tests the meet over them is the honest answer.
+    out = hits[0]
+    for h in hits[1:]:
+        out = out & h if must else out | h
+    return out
+
+
+class TestLockNames:
+    def test_word_matching_not_substring(self):
+        assert is_lock_name("_lock")
+        assert is_lock_name("_flush_lock")
+        assert is_lock_name("_cv")
+        assert is_lock_name("device_lock")
+        assert not is_lock_name("blocks")  # 'lock' as substring only
+        assert not is_lock_name("_by_key")
+        assert not is_lock_name("clockwise")
+
+
+class TestDataflow:
+    def test_with_block_holds_and_releases(self):
+        src = """
+        def f(self):
+            a = 1
+            with self._lock:
+                b = 2
+            c = 3
+        """
+        assert _held_at_line(src, 3) == frozenset()  # a = 1
+        assert _held_at_line(src, 5) == {"C._lock"}  # b = 2
+        assert _held_at_line(src, 6) == frozenset()  # c = 3
+
+    def test_branch_join_is_intersection(self):
+        src = """
+        def f(self, flag):
+            if flag:
+                self._lock.acquire()
+            touch()
+        """
+        assert _held_at_line(src, 5) == frozenset()  # touch()
+
+    def test_branch_join_is_union_in_may_mode(self):
+        src = """
+        def f(self, flag):
+            if flag:
+                self._lock.acquire()
+            touch()
+        """
+        assert _held_at_line(src, 5, must=False) == {"C._lock"}
+
+    def test_bounded_acquire_try_finally(self):
+        """The serving/jobs.py journal-flush shape: the lock is held
+        inside the try and provably released after the finally."""
+        src = """
+        def f(self):
+            if not self._lock.acquire(timeout=2.0):
+                return
+            try:
+                work()
+            finally:
+                self._lock.release()
+            after()
+        """
+        assert _held_at_line(src, 6) == {"C._lock"}  # work()
+        assert _held_at_line(src, 9) == frozenset()  # after()
+
+    def test_exception_into_handler_unwinds_the_with(self):
+        """A raise inside `with lock:` reaches the handler AFTER the
+        lock is released — the handler must not believe it is held."""
+        src = """
+        def f(self):
+            try:
+                with self._lock:
+                    work()
+            except ValueError:
+                cleanup()
+            done()
+        """
+        assert _held_at_line(src, 5) == {"C._lock"}  # work()
+        assert _held_at_line(src, 7) == frozenset()  # cleanup()
+        assert _held_at_line(src, 8) == frozenset()  # done()
+
+    def test_nested_with_stacks(self):
+        src = """
+        def f(self):
+            with self._a_lock:
+                with self._b_lock:
+                    work()
+                mid()
+            out()
+        """
+        assert _held_at_line(src, 5) == {"C._a_lock", "C._b_lock"}
+        assert _held_at_line(src, 6) == {"C._a_lock"}  # mid()
+        assert _held_at_line(src, 7) == frozenset()  # out()
+
+    def test_loop_back_edge_and_break(self):
+        """Lock taken per-iteration: not held at the loop head meet,
+        nor after a break that exits from inside the with."""
+        src = """
+        def f(self, items):
+            for it in items:
+                with self._lock:
+                    if bad(it):
+                        break
+                    work(it)
+            after()
+        """
+        assert _held_at_line(src, 5) == {"C._lock"}  # if bad(it)
+        assert _held_at_line(src, 7) == {"C._lock"}  # work(it)
+        assert _held_at_line(src, 8) == frozenset()  # after()
+
+    def test_early_return_unreachable_tail(self):
+        src = """
+        def f(self):
+            with self._lock:
+                return 1
+            tail()
+        """
+        fn = _fn(src)
+        resolve = make_resolver("C", "mod")
+        cfg = build_cfg(fn, resolve)
+        states = held_at_nodes(cfg, resolve)
+        lines = {
+            n.line for n in states if n.kind == "stmt" and n.line
+        }
+        assert 4 in lines  # the return is reachable
+        assert 5 not in lines  # tail() is unreachable, never analyzed
+
+    def test_return_keeps_enclosing_with_lock_through_finally(self):
+        """A return inside try/finally INSIDE a `with`: the runtime
+        still holds the lock while the finally body runs (`__exit__`
+        fires after) — the model must agree, or guarded cleanup in a
+        finally gets falsely flagged."""
+        src = """
+        def f(self):
+            with self._lock:
+                try:
+                    return work()
+                finally:
+                    cleanup()
+        """
+        assert _held_at_line(src, 7) == {"C._lock"}  # cleanup()
+
+    def test_return_releases_with_entered_inside_try(self):
+        """The converse: the `with` sits INSIDE the try, so its lock is
+        released before the finally body runs."""
+        src = """
+        def f(self):
+            try:
+                with self._lock:
+                    return work()
+            finally:
+                cleanup()
+        """
+        assert _held_at_line(src, 7) == frozenset()  # cleanup()
+
+    def test_seed_models_the_locked_convention(self):
+        src = """
+        def _drain_locked(self):
+            touch()
+        """
+        assert _held_at_line(
+            src, 3, seed=frozenset({"C._lock"})
+        ) == {"C._lock"}
+
+    def test_compound_headers_scan_only_their_own_exprs(self):
+        """An acquire inside an if BODY must not leak into the test
+        node's transfer — the header owns only its own expressions."""
+        src = """
+        def f(self, flag):
+            if flag:
+                self._lock.acquire()
+                inside()
+            touch()
+        """
+        fn = _fn(src)
+        resolve = make_resolver("C", "mod")
+        cfg = build_cfg(fn, resolve)
+        states = held_at_nodes(cfg, resolve)
+        for node in cfg.nodes:
+            if node.kind == "stmt" and node.line == 3:  # the if header
+                roots = node_scan_roots(node)
+                assert len(roots) == 1 and not isinstance(
+                    roots[0], ast.If
+                )
+        assert _held_at_line(src, 5) == {"C._lock"}  # inside()
+        assert _held_at_line(src, 6) == frozenset()  # touch(): join
+
+
+def _mini(tmp_path, rule_name, files):
+    """One-rule project over inline sources (mirrors test_graftlint's
+    golden-fixture harness, but for generated cases)."""
+    from tools.graftlint.rules import ALL_RULES
+
+    lines = ["[tool.graftlint]", "exclude = []"]
+    for r in ALL_RULES:
+        lines.append(f'[tool.graftlint.rules."{r.name}"]')
+        lines.append(
+            f"enabled = {'true' if r.name == rule_name else 'false'}"
+        )
+        if r.name == rule_name:
+            lines.append('paths = ["."]')
+    (tmp_path / "pyproject.toml").write_text("\n".join(lines) + "\n")
+    for name, src in files.items():
+        (tmp_path / name).write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+class TestLockDisciplineRule:
+    def test_interprocedural_edge_case_cross_object(self, tmp_path):
+        root = _mini(
+            tmp_path,
+            "lock-discipline",
+            {
+                "m.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._cv = threading.Condition()
+
+                    def _push_locked(self, item):
+                        pass
+
+                    def push(self, item):
+                        with self._cv:
+                            self._push_locked(item)
+
+                class T:
+                    def __init__(self):
+                        self._q = Q()
+
+                    def leak(self, item):
+                        self._q._push_locked(item)
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert len(findings) == 1
+        assert "another object's *_locked" in findings[0].message
+
+    def test_release_outside_finally_is_flagged(self, tmp_path):
+        root = _mini(
+            tmp_path,
+            "lock-discipline",
+            {
+                "m.py": """
+                import threading
+
+                _io_lock = threading.Lock()
+
+                def risky():
+                    _io_lock.acquire()
+                    work()
+                    _io_lock.release()
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        msgs = "\n".join(f.message for f in findings)
+        assert "without a matching release() in a finally" in msgs
+        assert "outside a finally" in msgs
+
+
+class TestDeadlockOrderRule:
+    def test_interprocedural_cycle_through_typed_attr(self, tmp_path):
+        """A cycle only visible through a call: T holds T._lock and
+        calls J.append (which takes J._lock); J.flush holds J._lock
+        and calls back into a T method that takes T._lock."""
+        root = _mini(
+            tmp_path,
+            "deadlock-order",
+            {
+                "m.py": """
+                import threading
+
+                class J:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._t = T()  # forward ref: index is whole-scope
+
+                    def append(self, e):
+                        with self._lock:
+                            return e
+
+                    def flush(self):
+                        with self._lock:
+                            self._t.note()
+
+                class T:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._j = J()
+
+                    def note(self):
+                        with self._lock:
+                            pass
+
+                    def submit(self, e):
+                        with self._lock:
+                            self._j.append(e)
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert findings, "interprocedural ABBA cycle missed"
+        assert all("lock-order cycle" in f.message for f in findings)
+
+    def test_one_way_nesting_is_clean(self, tmp_path):
+        root = _mini(
+            tmp_path,
+            "deadlock-order",
+            {
+                "m.py": """
+                import threading
+
+                class J:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def append(self, e):
+                        with self._lock:
+                            return e
+
+                class T:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._j = J()
+
+                    def submit(self, e):
+                        with self._lock:
+                            return self._j.append(e)
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert findings == []
+
+    def test_lock_graph_shape(self, tmp_path):
+        root = _mini(
+            tmp_path,
+            "deadlock-order",
+            {
+                "m.py": """
+                import threading
+
+                _a_lock = threading.Lock()
+                _b_lock = threading.Lock()
+
+                def f():
+                    with _a_lock:
+                        with _b_lock:
+                            pass
+                """
+            },
+        )
+        graph = lock_graph(Project(root, load_config(root)))
+        assert graph["edges"] == [["m._a_lock", "m._b_lock"]]
+        assert set(graph["locks"]) == {"m._a_lock", "m._b_lock"}
+
+
+class TestGuardedFieldsRule:
+    def test_flow_sensitive_not_method_granular(self, tmp_path):
+        """The SAME method both reads guarded and (later, after the
+        with block) reads unguarded — only the second line fires."""
+        root = _mini(
+            tmp_path,
+            "guarded-fields",
+            {
+                "m.py": """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._n = 0
+
+                    def bump(self):
+                        with self._lock:
+                            self._n += 1
+                        return self._n
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert len(findings) == 1
+        assert findings[0].line == 12  # the post-with read, only
+        assert "unguarded read" in findings[0].message
+
+    def test_internally_synchronized_attr_exempt(self, tmp_path):
+        root = _mini(
+            tmp_path,
+            "guarded-fields",
+            {
+                "m.py": """
+                import threading
+
+                class Q:
+                    def __init__(self):
+                        self._cv = threading.Condition()
+                        self._items = []
+
+                    def pop(self):
+                        with self._cv:
+                            return self._items.pop()
+
+                class T:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._q = Q()
+                        self._n = 0
+
+                    def use(self):
+                        with self._lock:
+                            self._q = self._q  # guarded write of _q
+                            self._n += 1
+
+                    def fast(self):
+                        return self._q.pop()  # Q locks itself: exempt
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert findings == []
+
+
+class TestLockHierarchyDrift:
+    """docs/CONCURRENCY.md embeds the GL008-derived hierarchy as JSON;
+    the doc and the derivation must never disagree."""
+
+    def _doc_graph(self):
+        path = os.path.join(REPO_ROOT, "docs", "CONCURRENCY.md")
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        m = re.search(r"```json\n(.*?)```", text, re.S)
+        assert m, "docs/CONCURRENCY.md lost its lock-graph JSON block"
+        return json.loads(m.group(1))
+
+    def test_documented_hierarchy_matches_derivation(self):
+        derived = lock_graph(
+            Project(REPO_ROOT, load_config(REPO_ROOT))
+        )
+        assert self._doc_graph() == derived, (
+            "docs/CONCURRENCY.md and the GL008 derivation diverged — "
+            "re-run `python -m tools.graftlint --lock-graph` and "
+            "update the doc in the same PR"
+        )
+
+    def test_derived_graph_is_acyclic_on_the_real_tree(self):
+        from tools.graftlint.rules.deadlock_order import RULE
+
+        project = Project(REPO_ROOT, load_config(REPO_ROOT))
+        assert list(RULE.check(project)) == []

@@ -66,6 +66,21 @@ from spark_examples_tpu.utils.config import PcaConfig
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _lock_check_enabled():
+    """The *_locked runtime backstop (docs/CONCURRENCY.md) is ON for
+    the resilience suite too: the kill-resume chaos scenarios drive
+    the serving tier's lock-protected paths hard, and a discipline
+    violation must fail at its call site, not as a torn journal."""
+    prev = os.environ.get("SPARK_EXAMPLES_TPU_LOCK_CHECK")
+    os.environ["SPARK_EXAMPLES_TPU_LOCK_CHECK"] = "1"
+    yield
+    if prev is None:
+        os.environ.pop("SPARK_EXAMPLES_TPU_LOCK_CHECK", None)
+    else:
+        os.environ["SPARK_EXAMPLES_TPU_LOCK_CHECK"] = prev
+
+
 def _load_validator():
     spec = importlib.util.spec_from_file_location(
         "validate_trace",

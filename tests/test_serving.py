@@ -51,6 +51,20 @@ from spark_examples_tpu.utils.config import PcaConfig
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _lock_check_enabled():
+    """The *_locked runtime backstop (docs/CONCURRENCY.md) is ON for
+    this whole suite: every tier/queue operation the tests drive also
+    asserts its lock preconditions dynamically."""
+    prev = os.environ.get("SPARK_EXAMPLES_TPU_LOCK_CHECK")
+    os.environ["SPARK_EXAMPLES_TPU_LOCK_CHECK"] = "1"
+    yield
+    if prev is None:
+        os.environ.pop("SPARK_EXAMPLES_TPU_LOCK_CHECK", None)
+    else:
+        os.environ["SPARK_EXAMPLES_TPU_LOCK_CHECK"] = prev
+
+
 def _load_validator():
     import importlib.util
 
@@ -591,6 +605,103 @@ def _get(conn, path):
     resp = conn.getresponse()
     body = resp.read()
     return resp.status, (json.loads(body) if body.startswith(b"{") else None)
+
+
+class TestLockDiscipline:
+    """PR-7 regression pins: the *_locked runtime backstop and the
+    locked HTTP snapshot serialization (the unlocked job-state read
+    race GL009's audit surfaced)."""
+
+    def test_lock_check_asserts_unguarded_locked_call(self):
+        q = AdmissionQueue(4, 2)
+        with pytest.raises(AssertionError, match="_locked convention"):
+            q._push_locked(object(), "t", 0, 1)
+        assert q.depth() == 0  # the assert fired before any mutation
+        with q._cv:
+            q._push_locked(object(), "t", 0, 2)
+        assert q.depth() == 1 and q.in_flight("t") == 1
+
+    def test_lock_check_off_is_a_no_op(self):
+        prev = os.environ.pop("SPARK_EXAMPLES_TPU_LOCK_CHECK", None)
+        try:
+            q = AdmissionQueue(4, 2)
+            with q._cv:
+                q._push_locked(object(), "t", 0, 1)
+            # Unguarded *_locked call tolerated when the check is off
+            # (production default: zero overhead, GL007 still gates
+            # statically). _release_tenant_locked has no native guard
+            # of its own, unlike _push_locked's cv.notify().
+            q._release_tenant_locked("t")
+            assert q.in_flight("t") == 0
+        finally:
+            if prev is not None:
+                os.environ["SPARK_EXAMPLES_TPU_LOCK_CHECK"] = prev
+
+    def test_record_methods_serialize_under_the_tier_lock(
+        self, served_source, monkeypatch
+    ):
+        """Job objects are mutated by workers under the tier lock;
+        every HTTP-facing serialization path must hold it. Asserted at
+        the exact read: to_record runs with tier._lock owned."""
+        from spark_examples_tpu.serving.jobs import Job
+
+        src, base, _ = served_source
+        tier = AnalysisJobTier(AnalysisEngine(src), base, workers=0)
+        job, created = tier.submit(JobSpec(tenant="lab"))
+        assert created
+        owned = []
+        orig = Job.to_record
+
+        def spying(self, include_result=True):
+            owned.append(tier._lock._is_owned())
+            return orig(self, include_result=include_result)
+
+        monkeypatch.setattr(Job, "to_record", spying)
+        assert tier.record_of(job)["state"] == "queued"
+        assert tier.job_record(job.id)["id"] == job.id
+        assert tier.job_record("nope") is None
+        assert [r["id"] for r in tier.job_records()] == [job.id]
+        assert owned and all(owned), (
+            "a to_record ran without the tier lock held"
+        )
+
+    def test_replay_holds_the_tier_lock(
+        self, served_source, tmp_path, monkeypatch
+    ):
+        """The GL007 finding this PR fixed: _replay mutates the job
+        table and calls _prune_terminal_locked — under the tier lock,
+        uniformly, even from __init__."""
+        src, base, _ = served_source
+        tier = AnalysisJobTier(
+            AnalysisEngine(src),
+            base,
+            workers=0,
+            journal_dir=str(tmp_path),
+        )
+        tier.submit(JobSpec(tenant="lab"))
+        tier.close()
+
+        seen = []
+        orig = AnalysisJobTier._prune_terminal_locked
+
+        def spying(self):
+            seen.append(self._lock._is_owned())
+            return orig(self)
+
+        monkeypatch.setattr(
+            AnalysisJobTier, "_prune_terminal_locked", spying
+        )
+        resumed = AnalysisJobTier(
+            AnalysisEngine(src),
+            base,
+            workers=0,
+            journal_dir=str(tmp_path),
+        )
+        assert len(resumed.jobs()) == 1  # the replayed submission
+        assert seen and all(seen), (
+            "_replay ran _prune_terminal_locked without the tier lock"
+        )
+        resumed.close()
 
 
 class TestAnalyzeHttp:

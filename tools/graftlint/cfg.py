@@ -1,0 +1,333 @@
+"""Intraprocedural control-flow graphs for graftlint's flow-sensitive rules.
+
+One :class:`CFG` per function: statement-granularity nodes plus
+*synthetic* acquire/release nodes for ``with <lock>:`` blocks, so the
+reaching-locks lattice (:mod:`tools.graftlint.dataflow`) sees lock
+lifetimes as explicit events on the graph rather than re-deriving them
+from syntax at every program point.
+
+Fidelity choices (documented because every one shapes what the
+concurrency rules can and cannot prove):
+
+- **``with`` unwinding is modeled.** A statement raising inside
+  ``with self._lock:`` reaches the enclosing handler *through* a
+  release node — the handler provably does NOT hold the lock, exactly
+  like the runtime. ``break``/``continue`` out of a ``with`` likewise
+  pass through release nodes for every lock entered inside the loop.
+- **Every statement may raise.** Each statement node gets an edge to
+  the innermost exception continuation (handler dispatch, with-unwind
+  chain, or function exit). For the must-held analysis this is the
+  conservative direction: handlers meet (intersect) over every raising
+  point.
+- **``finally`` runs once with merged continuations.** The finally body
+  is built once; its exit edges are the union of the continuations that
+  can reach it (normal fall-through, uncaught-exception propagation,
+  ``return`` routing). Merging paths can only shrink a must-held set,
+  never grow it — safe for GL007/GL009.
+- **Nested ``def``/``class``/``lambda`` bodies are opaque.** They
+  execute on other call stacks; the enclosing function's lock state
+  neither enters nor leaves them here.
+- Compound statements without explicit handling (``match``) degrade to
+  a single opaque node.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["CFG", "Node", "build_cfg"]
+
+# resolve(expr) -> canonical lock key ("Class._lock") or None.
+LockResolver = Callable[[ast.AST], Optional[str]]
+
+
+class Node:
+    """One CFG vertex.
+
+    ``kind`` is one of ``entry``/``exit``/``stmt``/``acquire``/
+    ``release``; ``stmt`` is the owning AST statement (None for
+    entry/exit); ``lock`` is the resolved lock key on synthetic
+    acquire/release nodes.
+    """
+
+    __slots__ = ("idx", "kind", "stmt", "lock", "succs")
+
+    def __init__(
+        self,
+        idx: int,
+        kind: str,
+        stmt: Optional[ast.stmt],
+        lock: Optional[str] = None,
+    ) -> None:
+        self.idx = idx
+        self.kind = kind
+        self.stmt = stmt
+        self.lock = lock
+        self.succs: List["Node"] = []
+
+    @property
+    def line(self) -> int:
+        return getattr(self.stmt, "lineno", 0) if self.stmt else 0
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        extra = f" {self.lock}" if self.lock else ""
+        return f"<Node {self.idx} {self.kind}{extra} L{self.line}>"
+
+
+class CFG:
+    """The graph: ``entry`` → ... → ``exit`` over :class:`Node`."""
+
+    def __init__(self, fn: ast.AST) -> None:
+        self.fn = fn
+        self.nodes: List[Node] = []
+        self.entry = self.new_node("entry", None)
+        self.exit = self.new_node("exit", None)
+
+    def new_node(
+        self,
+        kind: str,
+        stmt: Optional[ast.stmt],
+        lock: Optional[str] = None,
+    ) -> Node:
+        node = Node(len(self.nodes), kind, stmt, lock)
+        self.nodes.append(node)
+        return node
+
+    def preds(self) -> Dict[Node, List[Node]]:
+        out: Dict[Node, List[Node]] = {n: [] for n in self.nodes}
+        for n in self.nodes:
+            for s in n.succs:
+                out[s].append(n)
+        return out
+
+
+class _Loop:
+    """Break/continue routing for the innermost loop."""
+
+    __slots__ = ("head", "breaks", "with_depth")
+
+    def __init__(self, head: Node, with_depth: int) -> None:
+        self.head = head
+        self.breaks: List[Node] = []
+        # How many with-held locks were entered OUTSIDE this loop: a
+        # break/continue releases only the locks entered inside it.
+        self.with_depth = with_depth
+
+
+class _Fin:
+    """One enclosing ``finally`` a return must route through: its entry
+    node plus the with-depth at try entry — a return unwinds only the
+    locks entered INSIDE the try (an enclosing ``with``'s lock is still
+    held while the finally body runs; the ``__exit__`` fires after)."""
+
+    __slots__ = ("entry", "with_depth")
+
+    def __init__(self, entry: Node, with_depth: int) -> None:
+        self.entry = entry
+        self.with_depth = with_depth
+
+
+class _Builder:
+    def __init__(self, cfg: CFG, resolve: LockResolver) -> None:
+        self.cfg = cfg
+        self.resolve = resolve
+        # Stack of lock keys entered via `with` in the current lexical
+        # path (for break/continue unwind routing).
+        self.with_keys: List[str] = []
+
+    # `frontier` is the set of nodes whose next normal successor is the
+    # statement about to be built; each _build_* returns the new
+    # frontier (empty = control never falls through).
+
+    def seq(
+        self,
+        body: Sequence[ast.stmt],
+        frontier: List[Node],
+        exc: Node,
+        loop: Optional[_Loop],
+        fin_chain: Tuple["_Fin", ...],
+    ) -> List[Node]:
+        for stmt in body:
+            if not frontier:
+                break  # unreachable code after return/raise/break
+            frontier = self.stmt(stmt, frontier, exc, loop, fin_chain)
+        return frontier
+
+    def _link(self, frontier: Sequence[Node], target: Node) -> None:
+        for n in frontier:
+            if target not in n.succs:
+                n.succs.append(target)
+
+    def _stmt_node(
+        self, stmt: ast.stmt, frontier: List[Node], exc: Node
+    ) -> Node:
+        node = self.cfg.new_node("stmt", stmt)
+        self._link(frontier, node)
+        # Any statement may raise: edge to the innermost exception
+        # continuation (with-unwind chain / handler dispatch / exit).
+        if exc is not node:
+            node.succs.append(exc)
+        return node
+
+    def _unwind_to(self, start: Node, upto_depth: int, target: Node) -> None:
+        """Route ``start`` to ``target`` through release nodes for every
+        with-held lock above ``upto_depth`` (innermost first)."""
+        cur = start
+        for key in reversed(self.with_keys[upto_depth:]):
+            rel = self.cfg.new_node("release", cur.stmt, key)
+            cur.succs.append(rel)
+            cur = rel
+        cur.succs.append(target)
+
+    def stmt(
+        self,
+        stmt: ast.stmt,
+        frontier: List[Node],
+        exc: Node,
+        loop: Optional[_Loop],
+        fin_chain: Tuple["_Fin", ...],
+    ) -> List[Node]:
+        if isinstance(stmt, (ast.If,)):
+            test = self._stmt_node(stmt, frontier, exc)
+            then_out = self.seq(stmt.body, [test], exc, loop, fin_chain)
+            else_out = self.seq(stmt.orelse, [test], exc, loop, fin_chain)
+            if not stmt.orelse:
+                else_out = [test]
+            return then_out + else_out
+
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = self._stmt_node(stmt, frontier, exc)
+            inner = _Loop(head, len(self.with_keys))
+            body_out = self.seq(stmt.body, [head], exc, inner, fin_chain)
+            self._link(body_out, head)  # back edge
+            after: List[Node] = inner.breaks
+            # Loop-exit path (condition false / iterator exhausted),
+            # possibly through an `else` clause.
+            else_out = self.seq(stmt.orelse, [head], exc, loop, fin_chain)
+            after = after + (else_out if stmt.orelse else [head])
+            return after
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            header = self._stmt_node(stmt, frontier, exc)
+            keys = [
+                k
+                for k in (
+                    self.resolve(item.context_expr) for item in stmt.items
+                )
+                if k is not None
+            ]
+            cur: List[Node] = [header]
+            body_exc = exc
+            for key in keys:
+                acq = self.cfg.new_node("acquire", stmt, key)
+                self._link(cur, acq)
+                cur = [acq]
+                # Exception inside the body unwinds through a release
+                # of this lock before reaching the outer continuation.
+                unwind = self.cfg.new_node("release", stmt, key)
+                unwind.succs.append(body_exc)
+                body_exc = unwind
+                self.with_keys.append(key)
+            body_out = self.seq(stmt.body, cur, body_exc, loop, fin_chain)
+            for key in reversed(keys):
+                self.with_keys.pop()
+                rel = self.cfg.new_node("release", stmt, key)
+                self._link(body_out, rel)
+                body_out = [rel]
+            return body_out
+
+        if isinstance(stmt, ast.Try):
+            # The finally entry exists BEFORE the body is built so that
+            # return/uncaught-exception routing inside can target it.
+            fin_entry: Optional[Node] = None
+            if stmt.finalbody:
+                fin_entry = self.cfg.new_node("stmt", stmt)
+            # Exception continuation inside the body: each handler
+            # entry, plus (uncaught) the finally or the outer exc.
+            dispatch = self.cfg.new_node("stmt", stmt)
+            body_exc = dispatch
+            inner_fin = (
+                (_Fin(fin_entry, len(self.with_keys)),) + fin_chain
+                if fin_entry
+                else fin_chain
+            )
+            body_out = self.seq(
+                stmt.body, frontier, body_exc, loop, inner_fin
+            )
+            body_out = self.seq(
+                stmt.orelse, body_out, body_exc, loop, inner_fin
+            )
+            handler_outs: List[Node] = []
+            for handler in stmt.handlers:
+                h_entry = self.cfg.new_node("stmt", handler)
+                dispatch.succs.append(h_entry)
+                h_exc = fin_entry if fin_entry is not None else exc
+                handler_outs += self.seq(
+                    handler.body, [h_entry], h_exc, loop, inner_fin
+                )
+            # Uncaught path: dispatch also propagates outward (through
+            # finally when present). A bare `except:` still gets this
+            # edge — conservative, and harmless for must-analysis.
+            dispatch.succs.append(fin_entry if fin_entry else exc)
+            if fin_entry is not None:
+                self._link(body_out + handler_outs, fin_entry)
+                fin_out = self.seq(
+                    stmt.finalbody, [fin_entry], exc, loop, fin_chain
+                )
+                # Merged continuations: normal fall-through plus the
+                # propagation paths (outer exception target; function
+                # exit for returns routed here).
+                for n in fin_out:
+                    for target in (exc, self.cfg.exit):
+                        if target not in n.succs:
+                            n.succs.append(target)
+                return fin_out
+            return body_out + handler_outs
+
+        if isinstance(stmt, ast.Return):
+            node = self._stmt_node(stmt, frontier, exc)
+            if fin_chain:
+                # Route through the innermost finally, releasing ONLY
+                # the with-locks entered inside that try — a lock whose
+                # `with` encloses the try/finally is still held while
+                # the finally body runs.
+                fin = fin_chain[0]
+                self._unwind_to(node, fin.with_depth, fin.entry)
+            else:
+                self._unwind_to(node, 0, self.cfg.exit)
+            return []
+
+        if isinstance(stmt, ast.Raise):
+            node = self._stmt_node(stmt, frontier, exc)
+            # The raise edge to `exc` is already there.
+            return []
+
+        if isinstance(stmt, ast.Break):
+            node = self._stmt_node(stmt, frontier, exc)
+            if loop is not None:
+                sink = self.cfg.new_node("stmt", stmt)
+                self._unwind_to(node, loop.with_depth, sink)
+                loop.breaks.append(sink)
+            return []
+
+        if isinstance(stmt, ast.Continue):
+            node = self._stmt_node(stmt, frontier, exc)
+            if loop is not None:
+                self._unwind_to(node, loop.with_depth, loop.head)
+            return []
+
+        # Opaque statements (assignments, expressions, nested defs,
+        # imports, match, ...): one node, normal fall-through.
+        node = self._stmt_node(stmt, frontier, exc)
+        return [node]
+
+
+def build_cfg(fn: ast.AST, resolve: LockResolver) -> CFG:
+    """CFG for a FunctionDef/AsyncFunctionDef/Lambda body."""
+    cfg = CFG(fn)
+    body = fn.body if isinstance(fn.body, list) else [ast.Expr(fn.body)]
+    builder = _Builder(cfg, resolve)
+    out = builder.seq(body, [cfg.entry], cfg.exit, None, ())
+    builder._link(out, cfg.exit)
+    return cfg

@@ -1,0 +1,121 @@
+"""Shared cross-file class index for the concurrency rules.
+
+GL008 (deadlock-order) and GL009 (guarded-fields) both need the same
+lightweight whole-scope model: which classes exist, what locks each
+synchronizes on, and what class each ``self.<attr>`` is constructed as
+(``self._queue = AdmissionQueue(...)`` types ``_queue``). One
+*inference implementation*, two consumers — the rules cannot disagree
+about HOW an attribute is typed. Each rule still scans its own
+configured path set (the scopes legitimately differ: GL009 self-lints
+``tools/graftlint``, GL008 does not), so each builds its own model
+instance over its own scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint.astutil import dotted_name
+from tools.graftlint.dataflow import class_lock_keys, module_lock_keys
+from tools.graftlint.engine import Project
+
+__all__ = ["ClassInfo", "ScopeModel", "scan_scope"]
+
+
+class ClassInfo:
+    """One indexed class: its methods, locks, and typed attributes."""
+
+    __slots__ = ("name", "rel", "stem", "node", "methods", "attr_types", "locks")
+
+    def __init__(self, rel: str, stem: str, node: ast.ClassDef) -> None:
+        self.name = node.name
+        self.rel = rel
+        self.stem = stem
+        self.node = node
+        self.methods: Dict[str, ast.AST] = {
+            sub.name: sub
+            for sub in ast.iter_child_nodes(node)
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # attr name -> candidate class names (from constructor assigns).
+        self.attr_types: Dict[str, Set[str]] = {}
+        self.locks: FrozenSet[str] = frozenset()
+
+
+class ScopeModel:
+    """Everything the concurrency rules index over their scope."""
+
+    def __init__(self) -> None:
+        self.classes: Dict[str, ClassInfo] = {}
+        # (rel, stem, class name | None, function) to analyze.
+        self.functions: List[Tuple[str, str, Optional[str], ast.AST]] = []
+        self.module_locks: Dict[str, FrozenSet[str]] = {}
+        self.all_locks: Set[str] = set()
+
+    def attr_classes(self, info: ClassInfo, attr: str) -> List[ClassInfo]:
+        """Indexed ClassInfos an attribute of ``info`` may hold."""
+        return [
+            self.classes[n]
+            for n in sorted(info.attr_types.get(attr, ()))
+            if n in self.classes
+        ]
+
+    def attr_is_synchronized(self, info: ClassInfo, attr: str) -> bool:
+        """True when every inferred class for the attribute owns locks
+        of its own — an internally-synchronized collaborator whose
+        discipline is ITS OWN rules' business, not the holder's."""
+        candidates = self.attr_classes(info, attr)
+        return bool(candidates) and all(c.locks for c in candidates)
+
+
+def scan_scope(project: Project, paths: Iterable[str]) -> ScopeModel:
+    model = ScopeModel()
+    for top in paths:
+        for rel in project.walk(top):
+            ctx = project.file(rel)
+            if ctx is None or ctx.tree is None:
+                continue
+            stem = os.path.splitext(os.path.basename(rel))[0]
+            mod_locks = module_lock_keys(ctx.tree, stem)
+            model.module_locks[rel] = mod_locks
+            model.all_locks |= mod_locks
+            for node in ast.iter_child_nodes(ctx.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    model.functions.append((rel, stem, None, node))
+                elif isinstance(node, ast.ClassDef):
+                    info = ClassInfo(rel, stem, node)
+                    info.locks = class_lock_keys(node, stem)
+                    model.all_locks |= info.locks
+                    model.classes[node.name] = info
+                    for m in info.methods.values():
+                        model.functions.append((rel, stem, node.name, m))
+    # Attribute types: self.X = SomeIndexedClass(...) anywhere in the
+    # class (constructors are usually __init__, but late binds count).
+    for info in model.classes.values():
+        for m in info.methods.values():
+            for node in ast.walk(m):
+                if not isinstance(node, ast.Assign):
+                    continue
+                called: Set[str] = set()
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Call):
+                        cname = dotted_name(sub.func)
+                        if cname is None:
+                            continue
+                        last = cname.rsplit(".", 1)[-1]
+                        if last in model.classes:
+                            called.add(last)
+                if not called:
+                    continue
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        info.attr_types.setdefault(tgt.attr, set()).update(
+                            called
+                        )
+    return model

@@ -1,0 +1,304 @@
+"""Reaching-locks dataflow over graftlint CFGs.
+
+The lattice element at a program point is the set of lock keys held
+there. Two modes:
+
+- **must** (meet = intersection): a lock is in the state only if it is
+  held on EVERY path reaching the point — what GL007 (may this
+  ``*_locked`` call run here?) and GL009 (is this field access guarded?)
+  need. Unreachable predecessors are ⊤ and drop out of the meet.
+- **may** (meet = union): a lock is in the state if it is held on SOME
+  path — what GL008 needs to derive potential lock-order edges.
+
+Lock identity is canonical: ``ClassName.attr`` for ``self.<attr>``
+locks, ``<module-stem>.name`` for module-level locks. The resolver is
+built per analysis context by :func:`make_resolver`; an expression that
+does not *look like* a lock (see :func:`is_lock_name`) never becomes a
+key, so ``with obs.span(...)`` or ``with open(...)`` stay invisible.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import (
+    Callable,
+    Dict,
+    FrozenSet,
+    Iterator,
+    List,
+    Optional,
+    Set,
+    Tuple,
+)
+
+from tools.graftlint.astutil import dotted_name
+from tools.graftlint.cfg import CFG, Node, build_cfg
+
+__all__ = [
+    "Resolver",
+    "is_lock_name",
+    "make_resolver",
+    "class_lock_keys",
+    "module_lock_keys",
+    "held_at_nodes",
+    "scan_calls",
+    "manual_lock_ops",
+    "node_scan_roots",
+    "walk_skip_nested",
+    "build_cfg",
+]
+
+# A name is lock-like when one of its underscore-separated words is a
+# synchronization noun. Substring matching would be wrong ("blocks"
+# contains "lock"); word matching keeps data attributes out.
+_LOCK_WORDS = frozenset(
+    {
+        "lock",
+        "locks",
+        "cv",
+        "cond",
+        "condition",
+        "mutex",
+        "sem",
+        "semaphore",
+        "rlock",
+    }
+)
+_WORD_SPLIT = re.compile(r"[^a-zA-Z0-9]+")
+
+# resolve(expr) -> canonical lock key, or None for non-lock expressions.
+Resolver = Callable[[ast.AST], Optional[str]]
+
+
+def is_lock_name(name: str) -> bool:
+    """True when the (unqualified) attribute/variable name reads as a
+    lock: ``_lock``, ``_cv``, ``_flush_lock``, ``device_lock``..."""
+    return any(
+        w in _LOCK_WORDS for w in _WORD_SPLIT.split(name.lower()) if w
+    )
+
+
+def make_resolver(
+    class_name: Optional[str], module_stem: str
+) -> Resolver:
+    """Lock-key resolver for code inside ``class_name`` (None at module
+    level) of module ``module_stem``."""
+
+    def resolve(expr: ast.AST) -> Optional[str]:
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if not is_lock_name(parts[-1]):
+            return None
+        if parts[0] == "self" and len(parts) == 2:
+            owner = class_name if class_name else module_stem
+            return f"{owner}.{parts[1]}"
+        if parts[0] == "self":
+            # self.a.b.lock — a lock owned through another object;
+            # key it by the full path under the class for stability.
+            owner = class_name if class_name else module_stem
+            return f"{owner}.{'.'.join(parts[1:])}"
+        return f"{module_stem}.{name}"
+
+    return resolve
+
+
+def walk_skip_nested(
+    node: ast.AST, *, skip_self: bool = False
+) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nested function/class/lambda
+    bodies — they run on other call stacks. ``skip_self=True`` starts
+    from the node's children (walk a function's body without treating
+    the function itself as nested). The ONE shared implementation for
+    every flow-sensitive rule: what counts as opaque must never differ
+    between rules."""
+    stack: List[ast.AST] = (
+        list(ast.iter_child_nodes(node)) if skip_self else [node]
+    )
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(
+                child,
+                (
+                    ast.FunctionDef,
+                    ast.AsyncFunctionDef,
+                    ast.Lambda,
+                    ast.ClassDef,
+                ),
+            ):
+                continue
+            stack.append(child)
+
+
+def scan_calls(stmt: ast.AST) -> Iterator[ast.Call]:
+    """Calls syntactically inside one statement (nested defs opaque)."""
+    for sub in walk_skip_nested(stmt):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def node_scan_roots(node: Node) -> List[ast.AST]:
+    """The AST(s) a CFG node is *responsible for* evaluating.
+
+    Compound statements own only their header expressions — their body
+    statements are separate CFG nodes, and scanning the whole subtree
+    from the header would attribute inner lock operations (and field
+    accesses) to the wrong program point.
+    """
+    if node.kind != "stmt" or node.stmt is None:
+        return []
+    s = node.stmt
+    if isinstance(s, (ast.If, ast.While)):
+        return [s.test]
+    if isinstance(s, (ast.For, ast.AsyncFor)):
+        return [s.iter]
+    if isinstance(s, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in s.items]
+    if isinstance(s, ast.Try):
+        return []
+    if isinstance(s, ast.ExceptHandler):
+        return [s.type] if s.type is not None else []
+    if isinstance(
+        s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+    ):
+        return []
+    return [s]
+
+
+def manual_lock_ops(
+    stmt: ast.AST, resolve: Resolver
+) -> Tuple[List[str], List[str]]:
+    """(acquired, released) lock keys from explicit ``X.acquire(...)`` /
+    ``X.release()`` calls inside one statement."""
+    acquired: List[str] = []
+    released: List[str] = []
+    for call in scan_calls(stmt):
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        if func.attr not in ("acquire", "release"):
+            continue
+        key = resolve(func.value)
+        if key is None:
+            continue
+        (acquired if func.attr == "acquire" else released).append(key)
+    return acquired, released
+
+
+def class_lock_keys(cls: ast.ClassDef, module_stem: str) -> FrozenSet[str]:
+    """Every lock key a class's methods synchronize on via ``self``:
+    ``with self.X`` / ``self.X.acquire()`` where X is lock-like."""
+    resolve = make_resolver(cls.name, module_stem)
+    keys: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                key = resolve(item.context_expr)
+                if key is not None and key.startswith(cls.name + "."):
+                    keys.add(key)
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            if node.func.attr in ("acquire", "release"):
+                key = resolve(node.func.value)
+                if key is not None and key.startswith(cls.name + "."):
+                    keys.add(key)
+    return frozenset(keys)
+
+
+# Constructor names that bind a synchronization primitive.
+_LOCK_CTORS = frozenset(
+    {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+)
+
+
+def module_lock_keys(
+    tree: ast.AST, module_stem: str
+) -> FrozenSet[str]:
+    """Module-global lock keys: ``X = threading.Lock()``-style bindings
+    (a lock-like NAME alone is not enough — ``LOCK_CHECK_ENV = "..."``
+    is a string, not a lock) plus any bare lock-like name synchronized
+    on at module scope."""
+    keys: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                name = dotted_name(item.context_expr)
+                if name and "." not in name and is_lock_name(name):
+                    keys.add(f"{module_stem}.{name}")
+        elif isinstance(node, ast.Assign):
+            if not (
+                isinstance(node.value, ast.Call)
+                and (dotted_name(node.value.func) or "").rsplit(".", 1)[
+                    -1
+                ]
+                in _LOCK_CTORS
+            ):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and is_lock_name(tgt.id):
+                    keys.add(f"{module_stem}.{tgt.id}")
+    return frozenset(keys)
+
+
+def held_at_nodes(
+    cfg: CFG,
+    resolve: Resolver,
+    seed: FrozenSet[str] = frozenset(),
+    must: bool = True,
+) -> Dict[Node, FrozenSet[str]]:
+    """Solve the reaching-locks equations; returns IN[node] — the locks
+    held *entering* each reachable node (unreachable nodes absent)."""
+    preds = cfg.preds()
+    # OUT states; None = ⊤ (unreachable so far).
+    out: Dict[Node, Optional[FrozenSet[str]]] = {
+        n: None for n in cfg.nodes
+    }
+    in_states: Dict[Node, FrozenSet[str]] = {}
+
+    def transfer(node: Node, state: FrozenSet[str]) -> FrozenSet[str]:
+        if node.kind == "acquire" and node.lock is not None:
+            return state | {node.lock}
+        if node.kind == "release" and node.lock is not None:
+            return state - {node.lock}
+        if node.kind == "stmt" and node.stmt is not None:
+            for root in node_scan_roots(node):
+                acq, rel = manual_lock_ops(root, resolve)
+                if acq or rel:
+                    state = (state - frozenset(rel)) | frozenset(acq)
+        return state
+
+    worklist: List[Node] = [cfg.entry]
+    on_list = {cfg.entry}
+    while worklist:
+        node = worklist.pop()
+        on_list.discard(node)
+        if node is cfg.entry:
+            state: Optional[FrozenSet[str]] = seed
+        else:
+            state = None
+            for p in preds[node]:
+                p_out = out[p]
+                if p_out is None:
+                    continue
+                if state is None:
+                    state = p_out
+                elif must:
+                    state = state & p_out
+                else:
+                    state = state | p_out
+            if state is None:
+                continue  # still unreachable
+        in_states[node] = state
+        new_out = transfer(node, state)
+        if out[node] != new_out:
+            out[node] = new_out
+            for s in node.succs:
+                if s not in on_list:
+                    worklist.append(s)
+                    on_list.add(s)
+    return in_states

@@ -27,7 +27,7 @@ import json
 import os
 import re
 import sys
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "Finding",
@@ -266,7 +266,7 @@ def run_lint(
     root: str,
     paths: Sequence[str],
     rules: Optional[Sequence[Any]] = None,
-):
+) -> Tuple[List["Finding"], Dict[str, int]]:
     """Run all (enabled) rules; returns (findings, suppressed_counts).
 
     ``paths`` narrows *per-file* scoping: a rule only reports findings in
@@ -363,11 +363,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="List rules and exit"
     )
+    parser.add_argument(
+        "--lock-graph",
+        action="store_true",
+        help="Print the GL008-derived lock-acquisition hierarchy as "
+        "JSON and exit (the exact payload docs/CONCURRENCY.md embeds "
+        "and the drift test pins)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for rule in ALL_RULES:
             print(f"{rule.code}  {rule.name:20s} {rule.summary}")
+        return 0
+
+    if args.lock_graph:
+        from tools.graftlint.rules.deadlock_order import lock_graph
+
+        root = args.root or find_root(os.getcwd())
+        project = Project(root, load_config(root))
+        print(json.dumps(lock_graph(project), indent=2, sort_keys=True))
         return 0
 
     root = args.root or find_root(os.getcwd())

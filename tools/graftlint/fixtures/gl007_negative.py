@@ -1,0 +1,44 @@
+"""Golden negative for GL007 lock-discipline: every shape the real
+tree uses — with-blocks, sibling *_locked calls, the bounded
+acquire/try/finally-release journal-flush idiom, branches that
+re-join with the lock held on all paths."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def _drain_locked(self):
+        self._items.clear()
+
+    def _rebalance_locked(self):
+        self._drain_locked()  # sibling: caller's lock covers both
+
+    def guarded(self):
+        with self._lock:
+            self._drain_locked()
+
+    def guarded_in_branch(self, flag):
+        with self._lock:
+            if flag:
+                self._drain_locked()
+            else:
+                self._rebalance_locked()
+
+    def bounded_flush(self):
+        # The serving/jobs.py journal-flush shape: bounded acquire,
+        # release on every path via finally.
+        if not self._lock.acquire(timeout=2.0):
+            return
+        try:
+            self._drain_locked()
+        finally:
+            self._lock.release()
+
+    def loop_guarded(self, n):
+        for _ in range(n):
+            with self._lock:
+                self._drain_locked()

@@ -1,0 +1,41 @@
+"""Golden positive for GL007 lock-discipline: *_locked calls at
+unprotected program points and unpaired manual acquire/release."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def _drain_locked(self):
+        self._items.clear()
+
+    def unguarded_call(self):
+        self._drain_locked()  # no lock held here
+
+    def branch_leak(self, flag):
+        if flag:
+            self._lock.acquire()  # also: acquire with no finally-release
+        self._drain_locked()  # held on ONE branch only: not proven
+
+    def released_too_early(self):
+        with self._lock:
+            pass
+        self._drain_locked()  # the with block already released
+
+    def manual_no_finally(self):
+        self._lock.acquire()  # no release in a finally
+        self._items.append(1)
+        self._lock.release()  # and the release is exception-unsafe
+
+
+class Other:
+    def __init__(self, worker):
+        self._worker = worker
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            self._worker._drain_locked()  # cross-object *_locked call

@@ -1,0 +1,20 @@
+"""Golden pragma-suppressed case for GL007 lock-discipline."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def _drain_locked(self):
+        self._items.clear()
+
+    def drain(self):
+        with self._lock:
+            self._drain_locked()
+
+    def single_threaded_shutdown(self):
+        # Sound only because shutdown joins every worker first:
+        self._drain_locked()  # graftlint: disable=lock-discipline

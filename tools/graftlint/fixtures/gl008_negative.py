@@ -1,0 +1,40 @@
+"""Golden negative for GL008 deadlock-order: consistent global order
+(journal before ingest, everywhere), including an edge derived through
+a typed-attribute call — nesting is fine as long as it is one-way."""
+
+import threading
+
+_ingest_lock = threading.Lock()
+_journal_lock = threading.Lock()
+
+
+class Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def append(self, event):
+        with self._lock:
+            return event
+
+
+class Tier:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._journal = Journal()
+
+    def submit(self, event):
+        with self._lock:
+            # Tier._lock → Journal._lock: an edge, not a cycle.
+            return self._journal.append(event)
+
+
+def flush_then_ingest():
+    with _journal_lock:
+        with _ingest_lock:
+            pass
+
+
+def flush_then_ingest_again():
+    with _journal_lock:
+        with _ingest_lock:
+            pass

@@ -1,0 +1,20 @@
+"""Golden positive for GL008 deadlock-order: two code paths acquire
+the same pair of locks in opposite orders — the textbook ABBA
+deadlock."""
+
+import threading
+
+_ingest_lock = threading.Lock()
+_journal_lock = threading.Lock()
+
+
+def flush_then_ingest():
+    with _journal_lock:
+        with _ingest_lock:  # journal → ingest
+            pass
+
+
+def ingest_then_flush():
+    with _ingest_lock:
+        with _journal_lock:  # ingest → journal: the cycle
+            pass

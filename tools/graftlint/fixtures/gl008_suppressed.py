@@ -1,0 +1,22 @@
+"""Golden pragma-suppressed case for GL008 deadlock-order: both sides
+of the cycle carry a pragma (e.g. a transition window where one side is
+provably never reached concurrently)."""
+
+import threading
+
+_ingest_lock = threading.Lock()
+_journal_lock = threading.Lock()
+
+
+def flush_then_ingest():
+    with _journal_lock:
+        # graftlint: disable=deadlock-order
+        with _ingest_lock:
+            pass
+
+
+def ingest_then_flush():
+    with _ingest_lock:
+        # graftlint: disable=deadlock-order
+        with _journal_lock:
+            pass

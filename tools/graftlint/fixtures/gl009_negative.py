@@ -1,0 +1,37 @@
+"""Golden negative for GL009 guarded-fields: guarded everywhere it
+must be — construction writes exempt, *_locked methods inherit the
+caller's lock, never-guarded fields stay unconstrained."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._pending = []
+        self._label = "counter"  # never lock-guarded: unconstrained
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def _drain_locked(self):
+        out = list(self._pending)
+        self._pending.clear()
+        return out
+
+    def drain(self):
+        with self._lock:
+            return self._drain_locked()
+
+    def enqueue(self, item):
+        with self._lock:
+            self._pending.append(item)
+
+    def peek(self):
+        with self._lock:
+            return self._n
+
+    def rename(self, label):
+        self._label = label  # fine: _label has no guarded writes

@@ -1,0 +1,27 @@
+"""Golden positive for GL009 guarded-fields: a field written under the
+class lock, then read and mutated lock-free elsewhere."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._pending = []
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def enqueue(self, item):
+        with self._lock:
+            self._pending.append(item)
+
+    def peek(self):
+        return self._n  # unguarded read of a guarded field
+
+    def drain_fast(self):
+        out = list(self._pending)  # unguarded read
+        self._pending.clear()  # unguarded mutation
+        return out

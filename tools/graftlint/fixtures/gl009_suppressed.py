@@ -1,0 +1,19 @@
+"""Golden pragma-suppressed case for GL009 guarded-fields: the
+intentional lock-free fast-path read, documented and counted."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek_relaxed(self):
+        # Monotonic progress gauge: a stale read is acceptable, the
+        # GIL makes the single int load atomic.
+        return self._n  # graftlint: disable=guarded-fields

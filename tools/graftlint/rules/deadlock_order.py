@@ -1,0 +1,317 @@
+"""GL008 deadlock-order: derive the global lock-acquisition graph, reject cycles.
+
+A deadlock needs two threads taking the same two locks in opposite
+orders — a property of the WHOLE tree, invisible to any single diff.
+This rule derives the global acquisition graph statically:
+
+- **nodes** are canonical lock keys (``AnalysisJobTier._lock``,
+  ``AdmissionQueue._cv``, ``watchdog._flush_lock``, ...);
+- **edges** ``A → B`` exist where some program point provably *may*
+  hold ``A`` while acquiring ``B`` — directly (nested ``with`` /
+  manual acquire, via the may-held reaching-locks dataflow) or through
+  a call whose callee acquires ``B``: calls onto ``self`` methods and
+  onto attributes whose class is inferred from constructor assignments
+  (``self._queue = AdmissionQueue(...)`` types ``self._queue``), with
+  per-method lock summaries closed transitively over those same edges.
+
+Any cycle in the graph is a finding at each participating acquisition
+site. The acyclic graph itself is the machine-readable lock hierarchy:
+``python -m tools.graftlint --lock-graph`` emits it as JSON, and
+``docs/CONCURRENCY.md`` embeds that JSON verbatim — a drift test pins
+doc to derivation, so the documented hierarchy can never silently rot.
+
+The rule is ``project_wide``: a cycle between two files is never out of
+scope just because the CLI was pointed at one of them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import (
+    Dict,
+    FrozenSet,
+    Iterable,
+    Iterator,
+    List,
+    Set,
+    Tuple,
+)
+
+from tools.graftlint.astutil import dotted_name
+from tools.graftlint.classmodel import ScopeModel, scan_scope
+from tools.graftlint.dataflow import (
+    Resolver,
+    build_cfg,
+    held_at_nodes,
+    make_resolver,
+    manual_lock_ops,
+    node_scan_roots,
+    scan_calls,
+)
+from tools.graftlint.engine import Finding, Project
+
+NAME = "deadlock-order"
+CODE = "GL008"
+
+DEFAULT_PATHS = (
+    "spark_examples_tpu/serving",
+    "spark_examples_tpu/arrays",
+    "spark_examples_tpu/utils",
+)
+
+Edge = Tuple[str, str]
+
+
+def _direct_locks(fn: ast.AST, resolve: Resolver) -> FrozenSet[str]:
+    """Locks a function acquires lexically (with-items + manual)."""
+    keys: Set[str] = set()
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+        ):
+            continue
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                key = resolve(item.context_expr)
+                if key is not None:
+                    keys.add(key)
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            if node.func.attr == "acquire":
+                key = resolve(node.func.value)
+                if key is not None:
+                    keys.add(key)
+        stack.extend(ast.iter_child_nodes(node))
+    return frozenset(keys)
+
+
+def _summaries(model: ScopeModel) -> Dict[Tuple[str, str], FrozenSet[str]]:
+    """Per (class, method): every lock the method may acquire,
+    transitively through self-calls and typed-attribute calls."""
+    direct: Dict[Tuple[str, str], FrozenSet[str]] = {}
+    calls: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+    for cname, info in model.classes.items():
+        resolve = make_resolver(cname, info.stem)
+        for mname, fn in info.methods.items():
+            key = (cname, mname)
+            direct[key] = _direct_locks(fn, resolve)
+            out: Set[Tuple[str, str]] = set()
+            for call in scan_calls(fn):
+                func = call.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                recv = dotted_name(func.value)
+                if recv == "self" and func.attr in info.methods:
+                    out.add((cname, func.attr))
+                elif recv is not None and recv.startswith("self."):
+                    attr = recv.split(".", 2)[1]
+                    for tname in info.attr_types.get(attr, ()):
+                        tinfo = model.classes.get(tname)
+                        if tinfo and func.attr in tinfo.methods:
+                            out.add((tname, func.attr))
+            calls[key] = out
+    summary = dict(direct)
+    changed = True
+    while changed:
+        changed = False
+        for key, callees in calls.items():
+            cur = summary[key]
+            for callee in callees:
+                cur = cur | summary.get(callee, frozenset())
+            if cur != summary[key]:
+                summary[key] = cur
+                changed = True
+    return summary
+
+
+def _derive_edges(
+    model: ScopeModel,
+    summary: Dict[Tuple[str, str], FrozenSet[str]],
+) -> Dict[Edge, Tuple[str, int]]:
+    """Edge → first (file, line) acquisition site, deterministically."""
+    edges: Dict[Edge, Tuple[str, int]] = {}
+
+    def note(a: str, b: str, rel: str, line: int) -> None:
+        if a == b:
+            return  # re-entrant self-acquire is the RLock's business
+        site = (rel, line)
+        if (a, b) not in edges or site < edges[(a, b)]:
+            edges[(a, b)] = site
+
+    for rel, stem, cname, fn in model.functions:
+        info = model.classes.get(cname) if cname else None
+        resolve = make_resolver(cname, stem)
+        seed = (
+            info.locks
+            if info is not None
+            and fn.name.endswith("_locked")
+            and info.locks
+            else frozenset()
+        )
+        cfg = build_cfg(fn, resolve)
+        states = held_at_nodes(cfg, resolve, seed=seed, must=False)
+        for node in cfg.nodes:
+            held = states.get(node)
+            if not held:
+                continue
+            if node.kind == "acquire" and node.lock is not None:
+                for a in held:
+                    note(a, node.lock, rel, node.line)
+                continue
+            for root in node_scan_roots(node):
+                acq, _ = manual_lock_ops(root, resolve)
+                for b in acq:
+                    for a in held:
+                        note(a, b, rel, node.line)
+                for call in scan_calls(root):
+                    func = call.func
+                    if not isinstance(func, ast.Attribute):
+                        continue
+                    targets: FrozenSet[str] = frozenset()
+                    recv = dotted_name(func.value)
+                    if (
+                        recv == "self"
+                        and info is not None
+                        and func.attr in info.methods
+                    ):
+                        targets = summary.get(
+                            (info.node.name, func.attr), frozenset()
+                        )
+                    elif (
+                        recv is not None
+                        and recv.startswith("self.")
+                        and info is not None
+                    ):
+                        attr = recv.split(".", 2)[1]
+                        for tname in info.attr_types.get(attr, ()):
+                            tinfo = model.classes.get(tname)
+                            if tinfo and func.attr in tinfo.methods:
+                                targets = targets | summary.get(
+                                    (tname, func.attr), frozenset()
+                                )
+                    for b in targets:
+                        for a in held:
+                            note(a, b, rel, call.lineno)
+    return edges
+
+
+def _cycle_edges(edges: Iterable[Edge]) -> Set[Edge]:
+    """Edges participating in any cycle: both endpoints in one SCC."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    comp: Dict[str, int] = {}
+    counter = [0]
+    comp_id = [0]
+    stack: List[str] = []
+    on_stack: Set[str] = set()
+
+    def strongconnect(v: str) -> None:
+        # Iterative Tarjan (recursion depth is unbounded on big graphs).
+        work: List[Tuple[str, Iterator[str]]] = [
+            (v, iter(sorted(graph[v])))
+        ]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if low[node] == index[node]:
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp[w] = comp_id[0]
+                    if w == node:
+                        break
+                comp_id[0] += 1
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    comp_sizes: Dict[int, int] = {}
+    for v, c in comp.items():
+        comp_sizes[c] = comp_sizes.get(c, 0) + 1
+    return {
+        (a, b)
+        for a, b in edges
+        if comp[a] == comp[b] and comp_sizes[comp[a]] > 1
+    }
+
+
+def lock_graph(project: Project) -> Dict[str, object]:
+    """The derived hierarchy as stable JSON-ready data (no line
+    numbers: the doc embedding must not churn on unrelated edits)."""
+    rule_paths = project.rule_paths(NAME, DEFAULT_PATHS)
+    model = scan_scope(project, rule_paths)
+    edges = _derive_edges(model, _summaries(model))
+    return {
+        "locks": sorted(model.all_locks),
+        "edges": sorted([list(e) for e in edges]),
+    }
+
+
+class DeadlockOrderRule:
+    name = NAME
+    code = CODE
+    summary = (
+        "the derived global lock-acquisition graph (nested with/"
+        "acquire + typed-attribute call summaries) must stay acyclic"
+    )
+    project_wide = True
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        model = scan_scope(
+            project, project.rule_paths(NAME, DEFAULT_PATHS)
+        )
+        edges = _derive_edges(model, _summaries(model))
+        bad = _cycle_edges(edges.keys())
+        findings: List[Finding] = []
+        for a, b in sorted(bad):
+            rel, line = edges[(a, b)]
+            others = sorted(
+                f"{x} → {y}" for x, y in bad if (x, y) != (a, b)
+            )
+            findings.append(
+                Finding(
+                    NAME,
+                    CODE,
+                    rel,
+                    line,
+                    f"lock-order cycle: acquiring {b} while holding "
+                    f"{a} conflicts with the opposite ordering "
+                    f"elsewhere ({'; '.join(others)}) — two threads "
+                    "taking these paths concurrently deadlock; pick "
+                    "one global order (docs/CONCURRENCY.md) and "
+                    "restructure the latecomer",
+                )
+            )
+        return findings
+
+
+RULE = DeadlockOrderRule()

@@ -20,9 +20,9 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, Iterable, List, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from tools.graftlint.engine import Finding, Project
+from tools.graftlint.engine import FileCtx, Finding, Project
 
 NAME = "flag-registry"
 CODE = "GL004"
@@ -40,7 +40,7 @@ _DOC_FLAG = re.compile(r"(?<![\w-])--([a-z][a-z0-9]*(?:-[a-z0-9]+)*)(?![\w-])")
 
 
 def _add_argument_flags(
-    ctx,
+    ctx: Optional[FileCtx],
 ) -> List[Tuple[str, str, int, bool]]:
     """(flag, dest, line, bool_optional) for every add_argument call
     defining a long option."""
@@ -78,7 +78,9 @@ def _add_argument_flags(
     return out
 
 
-def _dataclass_fields(ctx, class_names: Iterable[str]) -> Set[str]:
+def _dataclass_fields(
+    ctx: Optional[FileCtx], class_names: Iterable[str]
+) -> Set[str]:
     fields: Set[str] = set()
     if ctx is None or ctx.tree is None:
         return fields
@@ -92,7 +94,7 @@ def _dataclass_fields(ctx, class_names: Iterable[str]) -> Set[str]:
     return fields
 
 
-def _consumed_dests(ctx) -> Set[str]:
+def _consumed_dests(ctx: Optional[FileCtx]) -> Set[str]:
     """Names read off an ``args`` namespace in the CLI module."""
     used: Set[str] = set()
     if ctx is None or ctx.tree is None:
@@ -246,7 +248,7 @@ class FlagRegistryRule:
         return findings
 
 
-def _line_of(ctx, needle: str) -> int:
+def _line_of(ctx: Optional[FileCtx], needle: str) -> int:
     if ctx is not None:
         for lineno, line in enumerate(ctx.lines, 1):
             if needle in line:

@@ -1,0 +1,251 @@
+"""GL009 guarded-fields: lock-guarded attributes stay guarded everywhere.
+
+Atomicity inference per class: if ANY write to ``self._x`` happens
+while a class lock is held, the lock is evidently what makes ``_x``
+coherent — so every other read or write of ``_x`` in the class must
+also hold it (or be an intentional, pragma'd lock-free access — the
+pragma policy and sanctioned examples live in docs/CONCURRENCY.md). The
+half-guarded field is the classic Python race: the author locked the
+writer, a later PR added a reader, and the GIL makes it pass every test
+while torn multi-step updates stay observable in production.
+
+What counts as a *write* (mutation coverage matters more than purity):
+
+- direct stores: ``self._x = v``, ``self._x += v``, ``del self._x``;
+- container stores through the attribute: ``self._x[k] = v``,
+  ``del self._x[k]``;
+- mutator method calls: ``self._x.append/pop/update/clear/...``;
+- ``heapq`` mutations taking the attribute as first argument.
+
+Guardedness is flow-sensitive (the must-held reaching-locks dataflow
+over the function CFG), so ``with self._lock:`` blocks, the bounded
+acquire/finally-release shape, and branches all resolve correctly.
+``*_locked`` methods are seeded as holding the class locks (their
+convention IS the precondition — GL007 proves the call sites).
+``__init__``/``__new__``/``__post_init__`` are construction-phase and
+exempt: no second thread can hold a reference yet. Underscore-private
+attributes only — a public attribute is an API whose synchronization
+contract belongs to its docstring, not to inference.
+
+Attributes whose constructor-inferred class owns locks of its own
+(``self._queue = AdmissionQueue(...)``) are *internally synchronized*
+collaborators and exempt: calling their thread-safe, mutator-named API
+(``pop``, ``discard``) lock-free is the design, and holding the outer
+lock for it would only manufacture nesting GL008 then has to order.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import FrozenSet, Iterable, List, Set, Tuple
+
+from tools.graftlint.astutil import dotted_name
+from tools.graftlint.classmodel import ScopeModel, scan_scope
+from tools.graftlint.dataflow import (
+    build_cfg,
+    class_lock_keys,
+    held_at_nodes,
+    is_lock_name,
+    make_resolver,
+    node_scan_roots,
+    walk_skip_nested,
+)
+from tools.graftlint.engine import Finding, Project
+
+NAME = "guarded-fields"
+CODE = "GL009"
+
+DEFAULT_PATHS = (
+    "spark_examples_tpu/serving",
+    "spark_examples_tpu/arrays",
+    "spark_examples_tpu/utils",
+)
+
+_CONSTRUCTION = frozenset({"__init__", "__new__", "__post_init__"})
+
+_MUTATORS = frozenset(
+    {
+        "append",
+        "appendleft",
+        "add",
+        "clear",
+        "discard",
+        "extend",
+        "insert",
+        "move_to_end",
+        "pop",
+        "popleft",
+        "popitem",
+        "remove",
+        "reverse",
+        "setdefault",
+        "sort",
+        "update",
+        "write",
+        "writelines",
+    }
+)
+_HEAP_FNS = frozenset(
+    {"heappush", "heappop", "heapify", "heappushpop", "heapreplace"}
+)
+
+# (attr, line, is_write)
+Access = Tuple[str, int, bool]
+
+
+def _is_self_attr(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    )
+
+
+def _tracked(attr: str) -> bool:
+    """Underscore-private, non-dunder, not itself a lock."""
+    return (
+        attr.startswith("_")
+        and not attr.startswith("__")
+        and not is_lock_name(attr)
+    )
+
+
+def _accesses(root: ast.AST) -> Iterable[Access]:
+    """Classified self-attribute accesses inside one scan root."""
+    writes: Set[int] = set()
+    for sub in walk_skip_nested(root):
+        if isinstance(sub, ast.Attribute) and _is_self_attr(sub):
+            if isinstance(sub.ctx, (ast.Store, ast.Del)):
+                writes.add(id(sub))
+        elif isinstance(sub, ast.Subscript):
+            # self._x[k] = v / del self._x[k]: the Attribute itself is
+            # a Load; the mutation is the subscript's context.
+            if isinstance(sub.ctx, (ast.Store, ast.Del)) and _is_self_attr(
+                sub.value
+            ):
+                writes.add(id(sub.value))
+        elif isinstance(sub, ast.Call):
+            func = sub.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _MUTATORS
+                and _is_self_attr(func.value)
+            ):
+                writes.add(id(func.value))
+            cname = dotted_name(func) or ""
+            if cname.rsplit(".", 1)[-1] in _HEAP_FNS and sub.args:
+                if _is_self_attr(sub.args[0]):
+                    writes.add(id(sub.args[0]))
+    for sub in walk_skip_nested(root):
+        if not (isinstance(sub, ast.Attribute) and _is_self_attr(sub)):
+            continue
+        if not _tracked(sub.attr):
+            continue
+        yield sub.attr, sub.lineno, id(sub) in writes
+
+
+class GuardedFieldsRule:
+    name = NAME
+    code = CODE
+    summary = (
+        "a self._x field ever written under a class lock is read and "
+        "written ONLY under it (construction exempt; pragma the "
+        "intentional lock-free paths)"
+    )
+    project_wide = False
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        paths = project.rule_paths(NAME, DEFAULT_PATHS)
+        # The cross-file class index: typed attributes whose class owns
+        # locks of its own (AdmissionQueue, _ResultCache, JobJournal)
+        # are internally synchronized — their mutator-looking method
+        # names (pop/discard/...) are thread-safe API, not races.
+        model = scan_scope(project, paths)
+        for top in paths:
+            for rel in project.walk(top):
+                ctx = project.file(rel)
+                if ctx is None or ctx.tree is None:
+                    continue
+                stem = os.path.splitext(os.path.basename(rel))[0]
+                for node in ast.iter_child_nodes(ctx.tree):
+                    if isinstance(node, ast.ClassDef):
+                        findings.extend(
+                            self._check_class(rel, stem, node, model)
+                        )
+        return findings
+
+    def _check_class(
+        self,
+        rel: str,
+        stem: str,
+        cls: ast.ClassDef,
+        model: ScopeModel,
+    ) -> List[Finding]:
+        locks = class_lock_keys(cls, stem)
+        if not locks:
+            return []
+        info = model.classes.get(cls.name)
+        synchronized = frozenset(
+            attr
+            for attr in (info.attr_types if info is not None else ())
+            if info is not None and model.attr_is_synchronized(info, attr)
+        )
+        resolve = make_resolver(cls.name, stem)
+        # (attr, line, write, guarded, method) over all non-construction
+        # methods, flow-sensitively.
+        observed: List[Tuple[str, int, bool, bool, str]] = []
+        for fn in ast.iter_child_nodes(cls):
+            if not isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if fn.name in _CONSTRUCTION:
+                continue
+            seed = (
+                locks if fn.name.endswith("_locked") else frozenset()
+            )
+            cfg = build_cfg(fn, resolve)
+            states = held_at_nodes(cfg, resolve, seed=seed, must=True)
+            for node in cfg.nodes:
+                held = states.get(node)
+                if held is None:
+                    continue
+                guarded = bool(held & locks)
+                for root in node_scan_roots(node):
+                    for attr, line, is_write in _accesses(root):
+                        if attr in synchronized:
+                            continue
+                        observed.append(
+                            (attr, line, is_write, guarded, fn.name)
+                        )
+        guarded_fields: FrozenSet[str] = frozenset(
+            attr
+            for attr, _, is_write, guarded, _ in observed
+            if is_write and guarded
+        )
+        lock_list = ", ".join(sorted(locks))
+        findings: List[Finding] = []
+        for attr, line, is_write, guarded, method in observed:
+            if attr not in guarded_fields or guarded:
+                continue
+            kind = "write to" if is_write else "read of"
+            findings.append(
+                Finding(
+                    NAME,
+                    CODE,
+                    rel,
+                    line,
+                    f"unguarded {kind} `self.{attr}` in "
+                    f"`{cls.name}.{method}`: the field is written "
+                    f"under a class lock ({lock_list}) elsewhere, so "
+                    "every access must hold it — or carry an explicit "
+                    "pragma documenting why lock-free is sound here",
+                )
+            )
+        findings.sort(key=lambda f: f.line)
+        return findings
+
+
+RULE = GuardedFieldsRule()

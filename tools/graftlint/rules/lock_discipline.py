@@ -1,0 +1,266 @@
+"""GL007 lock-discipline: the ``*_locked`` convention, proven by dataflow.
+
+The serving tier (PR 6) adopted the convention ``serving/queue.py``
+established: a method named ``*_locked`` asserts nothing and acquires
+nothing — it REQUIRES its owning lock to already be held by the caller.
+The convention is only as good as every call site, and a miss is a
+silent data race that no tier-1 test deterministically exercises.
+This rule makes it a review-time proof:
+
+1. **held-at-call-site** — a call to ``self.<m>_locked(...)`` may only
+   appear at program points where the must-held lock set (computed by
+   the reaching-locks dataflow over the function's CFG, through
+   ``with`` blocks, ``try/finally``, branches and loops) contains at
+   least one of the class's locks. A ``*_locked`` method's own body is
+   seeded with the class locks — the convention IS its precondition —
+   so sibling ``_locked`` → ``_locked`` calls verify.
+2. **cross-object privacy** — calling *another* object's ``*_locked``
+   method (``self._queue._push_locked(...)``) is flagged outright: no
+   intraprocedural analysis can prove a foreign lock is held, and the
+   underscore says it was never API.
+3. **manual acquire/release pairing** — an explicit ``X.acquire(...)``
+   must have a matching ``X.release()`` inside a ``finally`` block of
+   the same function (the only shape that releases on *every* path,
+   exceptions included — the discipline ``serving/jobs.py``'s bounded
+   journal-flush acquire models); a manual ``release()`` outside any
+   ``finally`` is flagged for the same reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint.astutil import dotted_name
+from tools.graftlint.dataflow import (
+    Resolver,
+    build_cfg,
+    class_lock_keys,
+    held_at_nodes,
+    make_resolver,
+    module_lock_keys,
+    node_scan_roots,
+    scan_calls,
+    walk_skip_nested,
+)
+from tools.graftlint.engine import Finding, Project
+
+NAME = "lock-discipline"
+CODE = "GL007"
+
+DEFAULT_PATHS = (
+    "spark_examples_tpu/serving",
+    "spark_examples_tpu/arrays",
+    "spark_examples_tpu/utils",
+    "spark_examples_tpu/parallel",
+)
+
+
+def _functions_with_context(
+    tree: ast.AST,
+) -> Iterable[Tuple[Optional[ast.ClassDef], ast.AST]]:
+    """(enclosing class | None, function) for module-level functions
+    and direct class methods. Functions nested inside functions run on
+    the same stack as their builder — analyzed opaquely as part of it."""
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    yield node, sub
+
+
+def _finally_release_keys(fn: ast.AST, resolve: Resolver) -> Set[str]:
+    """Lock keys released inside any ``finally`` body of ``fn``."""
+    keys: Set[str] = set()
+    for node in walk_skip_nested(fn, skip_self=True):
+        if not isinstance(node, ast.Try) or not node.finalbody:
+            continue
+        for stmt in node.finalbody:
+            for call in scan_calls(stmt):
+                if (
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "release"
+                ):
+                    key = resolve(call.func.value)
+                    if key is not None:
+                        keys.add(key)
+    return keys
+
+
+def _finally_node_ids(fn: ast.AST) -> Set[int]:
+    ids: Set[int] = set()
+    for node in walk_skip_nested(fn, skip_self=True):
+        if isinstance(node, ast.Try) and node.finalbody:
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    ids.add(id(sub))
+    return ids
+
+
+class LockDisciplineRule:
+    name = NAME
+    code = CODE
+    summary = (
+        "*_locked methods are only called where their owning lock is "
+        "provably held; manual acquire() pairs with release() in a "
+        "finally"
+    )
+    project_wide = False
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for top in project.rule_paths(NAME, DEFAULT_PATHS):
+            for rel in project.walk(top):
+                ctx = project.file(rel)
+                if ctx is None or ctx.tree is None:
+                    continue
+                stem = os.path.splitext(os.path.basename(rel))[0]
+                mod_locks = module_lock_keys(ctx.tree, stem)
+                for cls, fn in _functions_with_context(ctx.tree):
+                    findings.extend(
+                        self._check_function(
+                            rel, stem, cls, fn, mod_locks
+                        )
+                    )
+        return findings
+
+    def _check_function(
+        self,
+        rel: str,
+        stem: str,
+        cls: Optional[ast.ClassDef],
+        fn: ast.AST,
+        mod_locks: frozenset,
+    ) -> List[Finding]:
+        findings: List[Finding] = []
+        cls_name = cls.name if cls is not None else None
+        resolve = make_resolver(cls_name, stem)
+        own_locks = (
+            class_lock_keys(cls, stem) if cls is not None else mod_locks
+        )
+        seed = (
+            own_locks
+            if fn.name.endswith("_locked") and own_locks
+            else frozenset()
+        )
+        cfg = build_cfg(fn, resolve)
+        states = held_at_nodes(cfg, resolve, seed=seed, must=True)
+
+        for node in cfg.nodes:
+            held = states.get(node)
+            if held is None:
+                continue  # unreachable
+            for root in node_scan_roots(node):
+                for call in scan_calls(root):
+                    findings.extend(
+                        self._check_locked_call(
+                            rel, call, own_locks, mod_locks, held
+                        )
+                    )
+
+        # Manual acquire/release pairing (lexical over the function:
+        # the only exception-safe release shape is a finally).
+        fin_keys = _finally_release_keys(fn, resolve)
+        fin_ids = _finally_node_ids(fn)
+        for sub in walk_skip_nested(fn, skip_self=True):
+            if not isinstance(sub, ast.Call) or not isinstance(
+                sub.func, ast.Attribute
+            ):
+                continue
+            key = (
+                resolve(sub.func.value)
+                if sub.func.attr in ("acquire", "release")
+                else None
+            )
+            if key is None:
+                continue
+            if sub.func.attr == "acquire" and key not in fin_keys:
+                findings.append(
+                    Finding(
+                        NAME,
+                        CODE,
+                        rel,
+                        sub.lineno,
+                        f"manual {key}.acquire() without a matching "
+                        "release() in a finally block of this function "
+                        "— an exception between acquire and release "
+                        "leaks the lock forever; use `with` or the "
+                        "acquire/try/finally-release shape",
+                    )
+                )
+            elif sub.func.attr == "release" and id(sub) not in fin_ids:
+                findings.append(
+                    Finding(
+                        NAME,
+                        CODE,
+                        rel,
+                        sub.lineno,
+                        f"manual {key}.release() outside a finally "
+                        "block — any exception on the path to it "
+                        "skips the release and leaks the lock",
+                    )
+                )
+        return findings
+
+    def _check_locked_call(
+        self,
+        rel: str,
+        call: ast.Call,
+        own_locks: frozenset,
+        mod_locks: frozenset,
+        held: frozenset,
+    ) -> List[Finding]:
+        func = call.func
+        callee: Optional[str] = None
+        required: frozenset = frozenset()
+        if isinstance(func, ast.Attribute) and func.attr.endswith(
+            "_locked"
+        ):
+            recv = dotted_name(func.value)
+            if recv == "self":
+                callee = f"self.{func.attr}"
+                required = own_locks
+            else:
+                return [
+                    Finding(
+                        NAME,
+                        CODE,
+                        rel,
+                        call.lineno,
+                        f"call to another object's *_locked method "
+                        f"(`{recv or '<expr>'}.{func.attr}`): its "
+                        "owning lock cannot be proven held from here "
+                        "— route through a public method that takes "
+                        "the lock itself",
+                    )
+                ]
+        elif isinstance(func, ast.Name) and func.id.endswith("_locked"):
+            # A bare name resolves to a module-level *_locked function;
+            # its contract is the module's lock(s), when it has any.
+            callee = func.id
+            required = mod_locks
+        if callee is None or not required:
+            return []
+        if held & required:
+            return []
+        lock_list = ", ".join(sorted(required))
+        return [
+            Finding(
+                NAME,
+                CODE,
+                rel,
+                call.lineno,
+                f"`{callee}(...)` called at a point where none of its "
+                f"owning lock(s) ({lock_list}) is provably held on "
+                "every path — take the lock (or call from a *_locked "
+                "context)",
+            )
+        ]
+
+
+RULE = LockDisciplineRule()
