@@ -366,9 +366,129 @@ def cpu_reference_time(x):
     return t_accum + t_eig, coords
 
 
+def scale_out_sweep():
+    """BENCH_SCALE_OUT=1: the biobank N-scaling sweep (ROADMAP item 2).
+
+    Measures the sparse-aware Gramian engine (the ``--pca-mode sparse``
+    accumulation path: ``sparse_sharded_gramian_blockwise`` over a mesh
+    of every visible device) at N ∈ BENCH_SCALE_NS (default
+    ``2504,16384,65536``), holding carriers-per-variant fixed
+    (BENCH_SCALE_CARRIERS, default 128 — the rare-variant regime where
+    density d = k/N falls as N grows, the biobank AF shape) over
+    BENCH_SCALE_V variants (default 2048). Emits ONE JSON line with
+    ``sparse_gramian_nnz_per_sec`` per N plus wall time and full
+    backend/mesh provenance, so the biobank trajectory is tracked
+    across rounds the way warm ingest was. Timing-honesty rule as
+    everywhere: each accumulation is timed to a host readback of a G
+    element, never a dispatch enqueue.
+    """
+    import json as _json
+
+    import jax
+
+    from spark_examples_tpu.arrays.blocks import csr_windows
+    from spark_examples_tpu.parallel.mesh import make_mesh
+    from spark_examples_tpu.parallel.sharded import (
+        sparse_sharded_gramian_blockwise,
+    )
+
+    fallback = _backend_guard()
+    ns = [
+        int(s)
+        for s in os.environ.get(
+            "BENCH_SCALE_NS", "2504,16384,65536"
+        ).split(",")
+        if s.strip()
+    ]
+    carriers = int(os.environ.get("BENCH_SCALE_CARRIERS", 128))
+    n_variants = int(os.environ.get("BENCH_SCALE_V", 2048))
+    block_v = int(os.environ.get("BENCH_BLOCK_V", 8192))
+    mesh = make_mesh()
+    mesh_shape = dict(mesh.shape)
+
+    def cohort_pair(n, seed):
+        """Rare-variant CSR cohort: ``carriers`` distinct samples per
+        variant (capped at N), drawn directly in CSR — no dense
+        intermediate even host-side, so the sweep itself scales."""
+        rng = np.random.default_rng(seed)
+        k = min(carriers, n)
+        idx = np.empty(n_variants * k, dtype=np.int64)
+        for v in range(n_variants):
+            idx[v * k : (v + 1) * k] = rng.choice(n, size=k, replace=False)
+        offsets = np.arange(n_variants + 1, dtype=np.int64) * k
+        return idx, offsets
+
+    readback = jax.jit(lambda a: a.ravel()[:1])
+    sweep = []
+    for i, n in enumerate(ns):
+        pair = cohort_pair(n, seed=i)
+        nnz = int(pair[1][-1])
+
+        def run(pair=pair, n=n):
+            g = sparse_sharded_gramian_blockwise(
+                csr_windows(iter([pair]), block_v),
+                n,
+                mesh,
+                block_variants=block_v,
+            )
+            np.asarray(readback(g))  # host readback = the barrier
+
+        _log(f"bench: scale-out N={n} nnz={nnz} (warm) ...")
+        run()  # warm: compile + allocator
+        t = _best(run, repeat=int(os.environ.get("BENCH_SCALE_REPEAT", 2)))
+        sweep.append(
+            {
+                "n": n,
+                "variants": n_variants,
+                "nnz": nnz,
+                "density": round(nnz / (n * n_variants), 6),
+                "seconds": round(t, 4),
+                "nnz_per_sec": round(nnz / t, 2),
+            }
+        )
+        _log(
+            f"bench: scale-out N={n} {t:.3f}s "
+            f"({sweep[-1]['nnz_per_sec']:.0f} nnz/s)"
+        )
+    largest = sweep[-1]
+    print(
+        _json.dumps(
+            {
+                "metric": "sparse_gramian_nnz_per_sec",
+                "value": largest["nnz_per_sec"],
+                "unit": "nnz/s",
+                "backend": (
+                    "cpu-fallback" if fallback else jax.default_backend()
+                ),
+                "provenance": {
+                    "device_count": jax.device_count(),
+                    "mesh": mesh_shape,
+                    "devices": sorted(
+                        {d.platform for d in jax.devices()}
+                    ),
+                    "carriers_per_variant": carriers,
+                    "block_variants": block_v,
+                    "path": "parallel.sharded."
+                    "sparse_sharded_gramian_blockwise "
+                    "(cli pca --pca-mode sparse)",
+                },
+                "sweep": sweep,
+                "workload": "rare-variant CSR cohort, fixed "
+                "carriers-per-variant (density falls as 1/N — the "
+                "biobank AF shape)",
+                "timing": "host-readback barrier per accumulation",
+            }
+        )
+    )
+
+
 def main():
     from spark_examples_tpu import obs
     from spark_examples_tpu.obs.session import TelemetrySession
+
+    if os.environ.get("BENCH_SCALE_OUT"):
+        scale_out_sweep()
+        return
 
     # The bench always collects its own telemetry (the per-stage
     # breakdown rides in the output JSON); files are written only when

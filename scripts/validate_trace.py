@@ -59,6 +59,15 @@ _JOB_SPANS = {
     "job.replay",  # crash-recovery journal replay at tier startup
 }
 
+# Sparse-aware Gramian span contract (ops/sparse.py + the mesh-tiled
+# accumulator in parallel/sharded.py): every `gramian.sparse.<sub>`
+# span must be one of these — the biobank-trajectory capture windows
+# attribute scatter-vs-dense routing from exactly this set.
+_SPARSE_SPANS = {
+    "gramian.sparse.accumulate",  # one whole window-stream accumulation
+    "gramian.sparse.window",      # one CSR window (route=scatter|dense)
+}
+
 # Prometheus exposition line shapes (text format 0.0.4).
 _PROM_COMMENT = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ?.*$")
 _PROM_SAMPLE = re.compile(
@@ -126,6 +135,15 @@ def validate_trace(path: str) -> List[str]:
                 f"{where}: unknown job-tier span {ev['name']!r} "
                 f"(expected one of {sorted(_JOB_SPANS)})"
             )
+        elif (
+            ev["name"].startswith("gramian.sparse.")
+            and ev["name"] not in _SPARSE_SPANS
+        ):
+            errors.append(
+                f"{where}: unknown sparse-gramian span "
+                f"{ev['name']!r} (expected one of "
+                f"{sorted(_SPARSE_SPANS)})"
+            )
         if not isinstance(ev.get("pid"), int):
             errors.append(f"{where}: pid must be an int")
         if ph != "M":
@@ -164,6 +182,7 @@ _LABELED_COUNTERS = {
     "breaker_probe_total": "outcome",     # half-open probe outcomes
     "serving_jobs_total": "outcome",      # done/failed/cached/deduped
     "serving_shed_total": "reason",       # queue_full/quota
+    "sparse_gramian_windows_total": "route",  # scatter/dense per window
 }
 
 

@@ -25,7 +25,9 @@ __all__ = [
     "csr_windows",
     "packed_block_from_csr",
     "packed_blocks_from_csr",
+    "restrict_window_to_sample_range",
     "round_up_multiple",
+    "windows_from_calls",
     "DEFAULT_BLOCK_VARIANTS",
 ]
 
@@ -182,6 +184,79 @@ def csr_windows(
             yield emit(block_variants)
     if rows_buf:
         yield emit(rows_buf)
+
+
+def windows_from_calls(
+    calls_iter: Iterable[Sequence[int]],
+    block_variants: int = DEFAULT_BLOCK_VARIANTS,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Stream per-variant carrier lists into ``(indices, lens)`` windows.
+
+    The call-list twin of :func:`csr_windows` for sources without a CSR
+    tier (fixtures, staged multi-dataset merges): buffers
+    ``block_variants`` variants and emits the same window shape the
+    sparse Gramian engine consumes — per-variant carrier counts plus the
+    concatenated carrier indices, NEVER a densified block. Window
+    composition matches :func:`blocks_from_calls`'s block composition
+    variant-for-variant, which is what makes the sparse and dense
+    ingest routes directly comparable.
+    """
+    buf_idx: List[np.ndarray] = []
+    buf_lens: List[int] = []
+
+    def emit():
+        lens = np.asarray(buf_lens, dtype=np.int64)
+        idx = (
+            np.concatenate(buf_idx)
+            if buf_idx
+            else np.zeros(0, dtype=np.int64)
+        )
+        return idx, lens
+
+    for calls in calls_iter:
+        arr = np.asarray(calls, dtype=np.int64)
+        buf_lens.append(arr.size)
+        if arr.size:
+            buf_idx.append(arr)
+        if len(buf_lens) == block_variants:
+            yield emit()
+            buf_idx, buf_lens = [], []
+    if buf_lens:
+        yield emit()
+
+
+def restrict_window_to_sample_range(
+    window_idx: np.ndarray,
+    lens: np.ndarray,
+    lo: int,
+    hi: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Drop a window's carriers outside sample range ``[lo, hi)``.
+
+    The per-host sample-range ingest contract (docs/ARCHITECTURE.md): a
+    mesh host whose Gramian tiles cover sample rows/columns ``[lo, hi)``
+    never needs carriers outside the union — every pair with an
+    endpoint outside lands in a tile another host owns — so ingest may
+    drop them before they reach the device feed, bit-identically for
+    that host's tiles (pinned by test). Indices stay GLOBAL (the tile
+    kernels re-base); ``lens`` is recomputed per variant so the window
+    stays a valid CSR window. The full range ``(0, n)`` is a fast
+    no-op (the single-controller case).
+    """
+    window_idx = np.asarray(window_idx, dtype=np.int64)
+    lens = np.asarray(lens, dtype=np.int64)
+    if window_idx.size == 0 or (
+        lo <= 0 and (window_idx.size == 0 or hi > window_idx.max())
+    ):
+        return window_idx, lens
+    keep = (window_idx >= lo) & (window_idx < hi)
+    if bool(keep.all()):
+        return window_idx, lens
+    row_of = np.repeat(np.arange(lens.size, dtype=np.int64), lens)
+    new_lens = np.bincount(
+        row_of[keep], minlength=lens.size
+    ).astype(np.int64)
+    return window_idx[keep], new_lens
 
 
 def blocks_from_csr(

@@ -41,6 +41,15 @@ def _add_fixture_flags(p: argparse.ArgumentParser) -> None:
         help="Omit hom-ref calls from generated records (~10x faster at "
         "large N x V; identical pipeline results)",
     )
+    p.add_argument(
+        "--fixture-rare-af",
+        type=float,
+        default=None,
+        help="Cap generated variants' allele frequency near this value "
+        "(rare-variant biobank shape, ~98%% zeros at 0.01; group AFs "
+        "drawn in [0.5x, 1.5x) so population structure survives); "
+        "default keeps the common-variant beta draw",
+    )
 
 
 def _network_source(args):
@@ -135,6 +144,7 @@ def _offline_source(args, references: str):
             references=references,
             seed=args.fixture_seed,
             sparse_calls=args.fixture_sparse_calls,
+            rare_variant_af=getattr(args, "fixture_rare_af", None),
             variant_set_id=(args.variant_set_ids or [DEFAULT_VARIANT_SET_ID])[0],
         )
     return None
@@ -184,6 +194,7 @@ def _cmd_generate_fixture(args) -> int:
         references=args.references,
         seed=args.fixture_seed,
         sparse_calls=args.fixture_sparse_calls,
+        rare_variant_af=getattr(args, "fixture_rare_af", None),
         variant_set_id=(args.variant_set_ids or [DEFAULT_VARIANT_SET_ID])[0],
     )
     if args.fixture_tumor_normal:

@@ -50,6 +50,7 @@ def synthetic_cohort(
     dropped_contig_every: Optional[int] = None,
     reference_blocks_every: Optional[int] = None,
     sparse_calls: bool = False,
+    rare_variant_af: Optional[float] = None,
     stats=None,
 ) -> FixtureSource:
     """Build an in-memory cohort with latent population structure.
@@ -72,6 +73,13 @@ def synthetic_cohort(
     generation and memory at large N×V with identical pipeline results
     (non-carrying calls never reach the Gramian; N comes from the callset
     index, not from call lists). Dense is the default for realism.
+
+    ``rare_variant_af``: cap every variant's allele frequency near this
+    value (per-group AFs drawn in [0.5·af, 1.5·af) so the population
+    structure survives) — the biobank-shaped rare-variant regime the
+    sparse Gramian path exists for (~98% zeros at af ≈ 0.01). ``None``
+    keeps the historical beta(0.4, 1.2) common-variant draw and an
+    identical RNG stream (seeded cohorts and goldens are unchanged).
     """
     callsets = cohort_callsets(n_samples, variant_set_id)
     return FixtureSource(
@@ -86,6 +94,7 @@ def synthetic_cohort(
                 dropped_contig_every=dropped_contig_every,
                 reference_blocks_every=reference_blocks_every,
                 sparse_calls=sparse_calls,
+                rare_variant_af=rare_variant_af,
             )
         ),
         callsets=callsets,
@@ -114,6 +123,7 @@ def cohort_record_stream(
     dropped_contig_every: Optional[int] = None,
     reference_blocks_every: Optional[int] = None,
     sparse_calls: bool = False,
+    rare_variant_af: Optional[float] = None,
 ):
     """The cohort generator as a RECORD STREAM — O(1) memory, so
     BASELINE-#4-scale cohorts (millions of variants, tens of GB of
@@ -121,6 +131,15 @@ def cohort_record_stream(
     to the in-memory path (:func:`synthetic_cohort` wraps this), so
     seeded cohorts and goldens are unchanged.
     """
+    if rare_variant_af is not None and not (0 < rare_variant_af <= 2 / 3):
+        # The per-group draw spans [0.5·af, 1.5·af): af > 2/3 silently
+        # saturates carrier probability past 1 (an ALL-carrier cohort —
+        # the opposite of the requested rare shape) and af <= 0 yields
+        # zero carriers everywhere. Refuse loudly instead.
+        raise ValueError(
+            f"rare_variant_af must be in (0, 2/3], got {rare_variant_af} "
+            "(the per-group draw spans [0.5x, 1.5x) of the value)"
+        )
     rng = np.random.default_rng(seed)
     regions = parse_references(references)
     callsets = cohort_callsets(n_samples, variant_set_id)
@@ -160,7 +179,14 @@ def cohort_record_stream(
         ref_base = _BASES[rng.integers(0, 4)]
         alt_base = _BASES[(rng.integers(1, 4) + _BASES.index(ref_base)) % 4]
         # Per-group allele frequency: structured signal for the PCoA.
-        group_af = rng.beta(0.4, 1.2, size=population_structure)
+        # The rare-variant regime draws ONLY when asked, so the default
+        # RNG stream (and every seeded golden) is untouched.
+        if rare_variant_af is not None:
+            group_af = rare_variant_af * (
+                0.5 + rng.random(population_structure)
+            )
+        else:
+            group_af = rng.beta(0.4, 1.2, size=population_structure)
         carrier_p = group_af[groups]
         gts = rng.random(n_samples) < carrier_p
         carriers = np.nonzero(gts)[0]

@@ -97,13 +97,27 @@ class VariantsPcaDriver:
                 f"--ingest-workers must be >= 1 (or 0 = auto), got "
                 f"{conf.ingest_workers}"
             )
-        if conf.pca_mode not in ("auto", "fused", "stream"):
+        if conf.pca_mode not in ("auto", "fused", "stream", "sparse"):
             # argparse choices only guard the CLI; a programmatic typo
             # ('streaming', 'Stream') would otherwise silently fall
             # through to the auto gate.
             raise ValueError(
-                f"pca_mode must be 'auto', 'fused', or 'stream'; got "
-                f"{conf.pca_mode!r}"
+                f"pca_mode must be 'auto', 'fused', 'stream', or "
+                f"'sparse'; got {conf.pca_mode!r}"
+            )
+        if conf.pca_mode == "sparse" and conf.checkpoint_dir:
+            # Snapshot digests cut at manifest positions; the sparse
+            # accumulator's window stream has no checkpoint grid yet.
+            # Refuse before ingest, not after hours of it.
+            raise ValueError(
+                "--pca-mode sparse does not compose with checkpointed "
+                "ingest yet; drop --checkpoint-dir or use --pca-mode "
+                "auto/stream"
+            )
+        if getattr(conf, "sparse_density_threshold", 0.02) < 0:
+            raise ValueError(
+                "--sparse-density-threshold must be >= 0, got "
+                f"{conf.sparse_density_threshold}"
             )
         if conf.pca_mode == "fused" and (
             conf.precise or mesh is not None or jax.process_count() > 1
@@ -116,6 +130,20 @@ class VariantsPcaDriver:
                 "--pca-mode fused requires a single-process, meshless, "
                 "non---precise run (use --pca-mode auto to fall back "
                 "automatically)"
+            )
+        if (
+            conf.pca_mode == "sparse"
+            and mesh is not None
+            and len({d.process_index for d in mesh.devices.flat}) > 1
+        ):
+            # The sparse tile scatter is single-controller today
+            # (parallel/sharded.sparse_sharded_gramian_blockwise); fail
+            # before ingest with the same routing advice the kernel
+            # gives.
+            raise ValueError(
+                "--pca-mode sparse serves host-local meshes only (any "
+                "device count); on a process-spanning mesh use the "
+                "packed dense pod path (--pca-mode auto/stream)"
             )
         self.conf = conf
         self.source = source
@@ -650,6 +678,148 @@ class VariantsPcaDriver:
                 g = allreduce_gramian(g)
         return g
 
+    def _sparse_selected(self) -> bool:
+        """Route the Gramian through the sparse-aware engine?
+
+        ``--pca-mode sparse`` forces it; ``auto`` selects it for the
+        biobank shape — a sample-sharded host-local mesh (G tiled, no
+        N×N on any device) on an uncheckpointed single-process run.
+        Everything else keeps the dense MXU tiers (which beat the
+        scatter at common-variant density — the per-window density gate
+        still routes dense-ish windows onto the MXU *inside* the sparse
+        engine either way).
+        """
+        mode = self.conf.pca_mode
+        if mode == "sparse":
+            return True
+        if mode != "auto":
+            return False
+        return (
+            self.mesh is not None
+            and not self._mesh_spans_processes()
+            and jax.process_count() == 1
+            and not self.conf.checkpoint_dir
+            and self._sample_sharded()
+        )
+
+    def _sparse_host_g_bytes(self) -> int:
+        """Per-host bytes the sparse accumulator's G occupies — the
+        streaming-sparse footprint bound: the f32 accumulator tiles this
+        host's devices hold (``(N/rows)·(N/cols)`` each on a mesh, the
+        full N² when meshless/replicated), with only a window-sized
+        transient on top (NOTES.md verdict #7's 16·N² host peak — int64
+        host G + f32 copy + jax buffer — is gone: the sparse engine
+        never accumulates on the host)."""
+        n = self.index.size
+        itemsize = 4  # f32 accumulator, exact below 2^24 counts
+        if self.mesh is not None and not self._mesh_spans_processes():
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from spark_examples_tpu.arrays.blocks import (
+                round_up_multiple,
+            )
+            from spark_examples_tpu.parallel.sharded import (
+                _axis_product,
+                _mesh_axes,
+            )
+
+            d_axis, m_axis = _mesh_axes(self.mesh)
+            spec = PartitionSpec(d_axis, m_axis)
+            n_padded = round_up_multiple(
+                n, _axis_product(self.mesh, spec)
+            )
+            sharding = NamedSharding(self.mesh, spec)
+            tiles = sharding.addressable_devices_indices_map(
+                (n_padded, n_padded)
+            )
+            total = 0
+            for row_sl, col_sl in tiles.values():
+                rows = (row_sl.stop or n_padded) - (row_sl.start or 0)
+                cols = (col_sl.stop or n_padded) - (col_sl.start or 0)
+                total += rows * cols * itemsize
+            return total
+        return n * n * itemsize
+
+    def _windows_to_gramian(self, windows):
+        """CSR carrier windows → finished G via the sparse-aware engine
+        (the ONE accumulation recipe both ``--pca-mode sparse`` ingest
+        and the stream alternate share): tile-sharded scatter on a
+        host-local mesh, single-device accumulation otherwise, with the
+        per-window density gate routing dense windows onto the MXU
+        inside either engine. Meshless multi-process runs merge per-host
+        partials over DCN exactly like the dense tiers."""
+
+        def cancellable():
+            from spark_examples_tpu.utils import softcancel
+
+            for window in windows:
+                softcancel.check("sparse gramian window boundary")
+                yield window
+
+        with self._watchdog().armed("sparse ingest+gramian"):
+            if self.mesh is not None and not self._mesh_spans_processes():
+                from spark_examples_tpu.parallel.sharded import (
+                    sparse_sharded_gramian_blockwise,
+                )
+
+                return sparse_sharded_gramian_blockwise(
+                    cancellable(),
+                    self.index.size,
+                    self.mesh,
+                    density_threshold=self.conf.sparse_density_threshold,
+                    block_variants=self.conf.block_variants,
+                )
+            from spark_examples_tpu.ops.sparse import (
+                sparse_gramian_blockwise,
+            )
+
+            g = sparse_gramian_blockwise(
+                cancellable(),
+                self.index.size,
+                density_threshold=self.conf.sparse_density_threshold,
+                block_variants=self.conf.block_variants,
+            )
+            if jax.process_count() > 1:
+                from spark_examples_tpu.parallel.distributed import (
+                    allreduce_gramian,
+                )
+
+                g = allreduce_gramian(g)
+            return g
+
+    def _gramian_sparse(self):
+        """Sparse-aware ingest: route the best available tier's output
+        as CSR carrier windows (never densified blocks) into
+        :meth:`_windows_to_gramian`. The CSR sidecar tier feeds windows
+        straight from ``(indices, offsets)`` pairs; call-list tiers go
+        through ``windows_from_calls`` — same window composition as the
+        dense path's block composition, so sparse-vs-dense G bit-identity
+        is comparable window for window."""
+        from spark_examples_tpu.arrays.blocks import (
+            csr_windows,
+            windows_from_calls,
+        )
+
+        if self._fused_csr_possible():
+            windows = csr_windows(
+                self.get_csr_fused(), self.conf.block_variants
+            )
+        elif self._fused_ingest_possible():
+            windows = windows_from_calls(
+                self.get_calls_fused(), self.conf.block_variants
+            )
+        elif self._fused_multi_possible():
+            windows = windows_from_calls(
+                self.get_calls_fused_multi(), self.conf.block_variants
+            )
+        else:
+            data = self.get_data()
+            filtered = [self.filter_dataset(d) for d in data]
+            windows = windows_from_calls(
+                self.get_calls(filtered), self.conf.block_variants
+            )
+        return self._windows_to_gramian(windows)
+
     def get_similarity_matrix_stream(
         self, calls: Iterable[List[int]], max_host_bytes: int = 4 << 30
     ):
@@ -657,52 +827,45 @@ class VariantsPcaDriver:
 
         The reference ships an uncalled alternate that trades the dense
         per-task N×N matrix for O(Σk²) shuffled pair contributions
-        (``VariantsPca.scala:248-279``). The TPU analog: host-side sparse
-        scatter-accumulation, profitable only when the cohort is so sparse
-        that Σk² ≪ N·V (the MXU path is otherwise strictly faster). Kept
-        for API/algorithm parity; ``run()`` uses the blockwise MXU path,
-        exactly as the reference's ``main`` uses the dense one.
+        (``VariantsPca.scala:248-279``). Since the sparse-aware engine
+        landed this IS that algorithm, done right: the calls stream
+        feeds CSR carrier windows into the same OOB-drop scatter
+        accumulation ``--pca-mode sparse`` runs (tile-sharded over the
+        driver's mesh when one is configured), so the O(Σk²) work runs
+        on device and the host never holds more than one window.
 
-        HOST-MEMORY BOUND: unlike the device paths (G lives in HBM,
-        sample-shardable over a mesh past ``--sample-shard-threshold``),
-        this alternate accumulates a dense int64 (N, N) on the HOST. The
-        fence bounds PEAK bytes, not just the accumulator: during the
-        final conversion the int64 G (8·N²), its float32 copy (4·N²),
-        and the jax buffer (4·N²) are simultaneously alive — 16·N² total
-        (~160 GB at N=100k, the stress regime the sharded path exists
-        for). ``max_host_bytes`` (default 4 GiB, N ≈ 16k) refuses beyond
-        that instead of silently OOM-ing the host; callers with the RAM
-        opt in explicitly.
+        FOOTPRINT BOUND (the streaming-sparse bound, replacing NOTES.md
+        verdict #7's 16·N² host peak): the only large allocation left is
+        the f32 G itself — per host, the tiles its devices hold
+        (``(N/rows)·(N/cols)`` each on a mesh, N² meshless) plus one
+        window transient. ``max_host_bytes`` refuses only when THAT
+        sharded per-host footprint exceeds the budget; callers with the
+        memory opt in explicitly, and a mesh spanning more hosts shrinks
+        the per-host share instead of hitting a hard wall at N ≈ 16k.
         """
-        from spark_examples_tpu.arrays.blocks import _check_indices
+        from spark_examples_tpu.arrays.blocks import windows_from_calls
 
         n = self.index.size
-        need = 16 * n * n  # peak: int64 G + f32 copy + jax buffer
+        need = self._sparse_host_g_bytes()
         if need > max_host_bytes:
+            layout = (
+                "tiled over the mesh"
+                if self.mesh is not None
+                else "single-device"
+            )
             raise ValueError(
-                f"get_similarity_matrix_stream accumulates a dense host "
-                f"int64 matrix: N={n} peaks at {need / 2**30:.1f} GiB "
-                f"(int64 G + float32 copy + jax buffer) > the "
-                f"{max_host_bytes / 2**30:.1f} GiB bound. Use the "
-                "blockwise MXU path (run()) — sample-sharded over a mesh "
-                "at this N — or pass max_host_bytes explicitly if this "
-                "host has the memory"
+                f"get_similarity_matrix_stream streams through the "
+                f"sparse device accumulator: N={n} needs "
+                f"{need / 2**30:.2f} GiB of per-host f32 Gramian tiles "
+                f"({layout}) plus one window transient > the "
+                f"{max_host_bytes / 2**30:.2f} GiB bound. Shard G over "
+                "more hosts (--mesh-shape across a pod shrinks the "
+                "per-host share) or pass max_host_bytes explicitly if "
+                "this host has the memory"
             )
-        g = np.zeros((n, n), dtype=np.int64)
-        for sample_indices in calls:
-            idx = np.asarray(sample_indices, dtype=np.int64)
-            _check_indices(idx, n)  # same loud failure as the dense path
-            g[np.ix_(idx, idx)] += 1
-        import jax.numpy as jnp
-
-        out = jnp.asarray(g.astype(np.float32))
-        if jax.process_count() > 1:
-            from spark_examples_tpu.parallel.distributed import (
-                allreduce_gramian,
-            )
-
-            out = allreduce_gramian(out)
-        return out
+        return self._windows_to_gramian(
+            windows_from_calls(calls, self.conf.block_variants)
+        )
 
     def get_similarity_matrix_checkpointed(self):
         """Shard-group ingest with incremental (G, cursor) snapshots.
@@ -1521,6 +1684,8 @@ class VariantsPcaDriver:
             or self.conf.elastic_checkpoint
         ):
             return self.get_similarity_matrix_checkpointed()
+        if self._sparse_selected():
+            return self._gramian_sparse()
         if self._fused_csr_possible():
             return self.get_similarity_matrix_csr(self.get_csr_fused())
         if self._fused_ingest_possible():

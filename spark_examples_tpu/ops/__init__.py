@@ -21,6 +21,11 @@ from spark_examples_tpu.ops.pcoa import (
     principal_components,
     mllib_principal_components_reference,
     normalize_eigvec_signs,
+    randomized_panel_width,
+)
+from spark_examples_tpu.ops.sparse import (
+    sparse_gramian_accumulate,
+    sparse_gramian_blockwise,
 )
 
 __all__ = [
@@ -32,4 +37,7 @@ __all__ = [
     "principal_components",
     "mllib_principal_components_reference",
     "normalize_eigvec_signs",
+    "randomized_panel_width",
+    "sparse_gramian_accumulate",
+    "sparse_gramian_blockwise",
 ]

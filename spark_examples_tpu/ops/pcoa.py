@@ -31,12 +31,38 @@ from spark_examples_tpu.ops.centering import double_center
 __all__ = [
     "SpectralGapWarning",
     "check_spectral_gap",
+    "randomized_panel_width",
     "topk_with_gap_check",
     "pcoa",
     "principal_components",
     "mllib_principal_components_reference",
     "normalize_eigvec_signs",
 ]
+
+
+def randomized_panel_width(n: int, k: int, oversample: int) -> int:
+    """Panel width p for a randomized top-k eigensolve — the ONE place
+    the k+1-values calling convention lives.
+
+    Every consumer of randomized subspace iteration
+    (:func:`spark_examples_tpu.parallel.sharded.topk_eig_randomized`,
+    and through it the sharded finish) needs the oversampled panel to
+    carry AT LEAST ``min(k+1, n)`` Ritz pairs: ``k`` for the returned
+    components plus one past the gap for :func:`check_spectral_gap`
+    (which silently returns when no value past index k−1 exists — the
+    silent-skip this helper exists to make impossible). Before this
+    helper the ``k + oversample`` arithmetic was duplicated implicitly
+    at call sites, so an ``oversample=0`` caller would both shrink the
+    subspace below the convention AND disable the degeneracy warning
+    without a trace. Centralized: ``min(n, k + max(oversample, 1))`` —
+    the floor guarantees the k+1-th value whenever the spectrum has one
+    (n > k), and the returned width is what callers must allocate and
+    slice against (``vecs[:, :k]``/``vals[:k]`` can then never drop a
+    requested component).
+    """
+    if k < 1:
+        raise ValueError(f"top-k eigensolve needs k >= 1, got {k}")
+    return min(n, k + max(int(oversample), 1))
 
 
 class SpectralGapWarning(UserWarning):

@@ -1,0 +1,293 @@
+"""Sparse-aware Gramian accumulation straight from CSR carrier windows.
+
+The dense path (:mod:`spark_examples_tpu.ops.gramian`) densifies every
+variant window into a 0/1 indicator block and rides the MXU — O(N²·V)
+matmul work and an (N, V_blk) transient regardless of how empty the
+block is. At biobank shape (N=100k-1M, ~98% zeros) that transient and
+the matmul are the wall. The decomposition papers (arxiv 1909.00954,
+arxiv 1808.03374) compute G = XᵀX from the sparse representation
+without ever densifying; this module is that path for the 0/1
+indicator Gramian:
+
+    G[i, j] += |{v : i ∈ carriers(v) and j ∈ carriers(v)}|
+
+accumulated as ONE scatter-add per window, directly from the
+``(indices, lens)`` CSR windows the ingest tier already produces
+(:func:`spark_examples_tpu.arrays.blocks.csr_windows`) — no densify, no
+bit-pack, no (N, V_blk) transient. Work is O(Σ k_v²) (k_v = carriers of
+variant v) instead of O(N²·V_blk): at density d the ratio is ~d², which
+is what makes the 98%-zeros regime tractable at all.
+
+Formulation (one-hot-free segment scatter): each window's ragged carrier
+lists are right-padded into a ``(V_blk, k_max)`` int32 index matrix with
+an out-of-range sentinel; the jitted kernel scatter-adds ``+1`` at every
+``(idx[v, a], idx[v, b])`` pair with OOB-drop semantics, so sentinel
+pairs vanish and the accumulation stays integer-exact (every update is
+an exact +1 in f32, the same exactness argument as the dense path —
+bit-identical G, pinned by tests). The scatter runs in fixed-size
+variant chunks under ``lax.scan`` so the update transient is bounded by
+``chunk · k_max²`` — never window-sized.
+
+Density routing: genuinely dense windows (common variants) would pay
+k_max² ≈ (dN)² per variant here while the MXU path pays N·V_blk — the
+scatter loses above a few percent density. ``sparse_gramian_blockwise``
+therefore routes each window by its own density: strictly below the
+threshold it scatters straight from CSR; at or above it densifies +
+bit-packs into the existing MXU accumulator. Both routes add exact
+integer counts, so the mix is bit-identical to either pure path
+(PERFORMANCE.md has the decision-log entry for the default).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "DEFAULT_SPARSE_DENSITY_THRESHOLD",
+    "SCATTER_CHUNK_VARIANTS",
+    "padded_carrier_matrix",
+    "scatter_pairs_chunked",
+    "sparse_gramian_accumulate",
+    "sparse_gramian_blockwise",
+    "window_density",
+    "window_route",
+]
+
+# Dense/sparse switch: windows with density STRICTLY below this scatter
+# straight from CSR; at or above it they densify onto the MXU path. The
+# default is the measured CPU crossover region with margin — see the
+# PERFORMANCE.md decision-log entry (sparse wins on work at any d < 1,
+# but a scatter update costs ~10-100x a matmul MAC, so the honest
+# crossover sits at a few percent density; biobank cohorts at ~2% sit
+# under it, 1000-Genomes common variants at ~10% over it).
+DEFAULT_SPARSE_DENSITY_THRESHOLD = 0.02
+
+# Variant rows scattered per lax.scan step: bounds the broadcast update
+# transient at chunk * k_max^2 elements (e.g. 256 * 256^2 f32 = 67 MB)
+# instead of the whole window's V_blk * k_max^2.
+SCATTER_CHUNK_VARIANTS = 256
+
+_MIN_CARRIER_BUCKET = 8
+
+
+def window_density(lens: np.ndarray, n_samples: int) -> float:
+    """nnz / (N · V) for one CSR window (0.0 for an empty window)."""
+    lens = np.asarray(lens)
+    if lens.size == 0 or n_samples == 0:
+        return 0.0
+    return float(lens.sum()) / (n_samples * lens.size)
+
+
+def window_route(
+    lens: np.ndarray, n_samples: int, density_threshold: float
+) -> str:
+    """``"scatter"`` | ``"dense"`` for one window — THE switch both the
+    single-device and mesh-sharded accumulators consult, so the two can
+    never disagree on a boundary case. Density exactly AT the threshold
+    routes dense (the MXU side of the tie), pinned by test.
+
+    Two gates, both required for scatter: the MEAN density (total work,
+    O(Σk²) pairs) and the MAX per-variant carrier fraction — scatter
+    cost and its update transient scale with k_max², so ONE common
+    variant (k ≈ N/4) buried in an otherwise-rare window would blow the
+    padded carrier matrix to k_bucket ≈ N while the mean density still
+    whispers "sparse". Such a window routes dense, where the MXU cost
+    is flat in k.
+    """
+    lens = np.asarray(lens)
+    if window_density(lens, n_samples) >= density_threshold:
+        return "dense"
+    if (
+        lens.size
+        and n_samples
+        and int(lens.max()) / n_samples >= density_threshold
+    ):
+        return "dense"
+    return "scatter"
+
+
+def _carrier_bucket(k: int) -> int:
+    """Round a window's max carrier count up to a power of two (min 8):
+    the padded index matrix's column count is a static jit shape, so
+    bucketing bounds executable count at O(log N) per block width."""
+    bucket = _MIN_CARRIER_BUCKET
+    while bucket < k:
+        bucket *= 2
+    return bucket
+
+
+def padded_carrier_matrix(
+    window_idx: np.ndarray,
+    lens: np.ndarray,
+    sentinel: int,
+    n_rows: Optional[int] = None,
+) -> np.ndarray:
+    """One CSR window → a ``(n_rows, k_bucket)`` int32 carrier matrix.
+
+    Row v holds variant v's carrier sample indices, right-padded with
+    ``sentinel`` (any index ≥ the scatter target's row count — padded
+    pairs are OOB and dropped by the kernel). ``n_rows`` pads the
+    variant axis (tail windows, scan-chunk alignment); padded rows are
+    all-sentinel and inert. Pure vectorized numpy — this is host work on
+    the ingest path, C-speed like the densify scatter it replaces.
+    """
+    lens = np.asarray(lens, dtype=np.int64)
+    window_idx = np.asarray(window_idx, dtype=np.int64)
+    rows = lens.size if n_rows is None else n_rows
+    if rows < lens.size:
+        raise ValueError(
+            f"n_rows {rows} < window variant count {lens.size}"
+        )
+    k_bucket = _carrier_bucket(int(lens.max()) if lens.size else 0)
+    mat = np.full((rows, k_bucket), sentinel, dtype=np.int32)
+    if window_idx.size:
+        row_of = np.repeat(np.arange(lens.size, dtype=np.int64), lens)
+        starts = np.zeros(lens.size, dtype=np.int64)
+        np.cumsum(lens[:-1], out=starts[1:])
+        pos = np.arange(window_idx.size, dtype=np.int64) - starts[row_of]
+        mat[row_of, pos] = window_idx
+    return mat
+
+
+def scatter_pairs_chunked(g, row_idx, col_idx):
+    """``g[row_idx[v,a], col_idx[v,b]] += 1`` for every (v, a, b) —
+    out-of-bounds indices dropped.
+
+    The ONE chunked-scan scatter body: the single-device kernel passes
+    the carrier matrix as both operands; the mesh-tiled kernel passes
+    tile-re-based row/column copies. Shared so a chunking or exactness
+    change can never land in one copy and silently miss the other (the
+    bit-identity contract the tests pin). Index arrays are
+    ``(V_pad, k_bucket)`` with V_pad a multiple of the scan chunk; the
+    scan bounds the broadcast update transient at
+    ``chunk · k_bucket²``. Every update is an exact integer +1 in
+    ``g.dtype`` — the same below-2^24 exactness contract as the dense
+    accumulator.
+    """
+    one = jnp.asarray(1, g.dtype)
+    shape = (
+        row_idx.shape[0] // SCATTER_CHUNK_VARIANTS,
+        SCATTER_CHUNK_VARIANTS,
+        row_idx.shape[1],
+    )
+
+    def body(acc, chunk):
+        ci, cj = chunk
+        return (
+            acc.at[ci[:, :, None], cj[:, None, :]].add(one, mode="drop"),
+            None,
+        )
+
+    g, _ = jax.lax.scan(
+        body, g, (row_idx.reshape(shape), col_idx.reshape(shape))
+    )
+    return g
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_accumulate_jit(g, idx):
+    """``g[idx[v,a], idx[v,b]] += 1`` for every (v, a, b) — OOB dropped."""
+    return scatter_pairs_chunked(g, idx, idx)
+
+
+def _pad_rows_for_scan(rows: int) -> int:
+    """Variant-axis padding so the scan chunking divides evenly."""
+    from spark_examples_tpu.arrays.blocks import round_up_multiple
+
+    return round_up_multiple(max(rows, 1), SCATTER_CHUNK_VARIANTS)
+
+
+def sparse_gramian_accumulate(g, window_idx, lens):
+    """One sparse accumulation step: scatter a CSR window into G.
+
+    ``g`` is the ``(N, N)`` device accumulator (donated — updates in
+    place in device memory); the window is host CSR ``(indices, lens)``.
+    Returns the updated G. Bit-identical to densifying the window and
+    running ``gramian_accumulate`` (pinned by tests).
+    """
+    idx = padded_carrier_matrix(
+        window_idx,
+        lens,
+        sentinel=g.shape[0],
+        n_rows=_pad_rows_for_scan(np.asarray(lens).size),
+    )
+    return _scatter_accumulate_jit(g, idx)
+
+
+def _note_window(route: str, nnz: int) -> None:
+    """Per-window telemetry shared by the single-device and mesh
+    accumulators (one registration site per metric, GL003)."""
+    from spark_examples_tpu import obs
+
+    reg = obs.get_registry()
+    reg.counter(
+        "sparse_gramian_windows_total",
+        "CSR windows accumulated by the sparse-aware Gramian engine",
+    ).labels(route=route).inc()
+    reg.counter(
+        "sparse_gramian_nnz_total",
+        "Genotype carriers (nonzeros) accumulated by the sparse engine",
+    ).inc(nnz)
+
+
+def sparse_gramian_blockwise(
+    windows: Iterable[Tuple[np.ndarray, np.ndarray]],
+    n_samples: int,
+    accum_dtype=jnp.float32,
+    density_threshold: float = DEFAULT_SPARSE_DENSITY_THRESHOLD,
+    block_variants: Optional[int] = None,
+    device=None,
+):
+    """Stream CSR windows into a single-device G, routing per density.
+
+    ``windows`` yields ``(indices, lens)`` pairs (``csr_windows``
+    output). Sparse windows scatter straight from CSR; dense windows
+    take the historical densify → bit-pack → MXU route (padded to
+    ``block_variants`` so the packed executable shape stays stable).
+    The mix is bit-identical to the pure dense path — both routes add
+    exact integer counts.
+    """
+    from spark_examples_tpu import obs
+    from spark_examples_tpu.arrays.blocks import (
+        DEFAULT_BLOCK_VARIANTS,
+        _check_indices,
+        _densify_window,
+    )
+    from spark_examples_tpu.ops.gramian import (
+        gramian_accumulate_packed,
+        pack_indicator_block,
+    )
+
+    width = block_variants or DEFAULT_BLOCK_VARIANTS
+    g = jnp.zeros((n_samples, n_samples), dtype=accum_dtype)
+    if device is not None:
+        g = jax.device_put(g, device)
+    with obs.span("gramian.sparse.accumulate", n=n_samples):
+        for window_idx, lens in windows:
+            lens = np.asarray(lens)
+            _check_indices(np.asarray(window_idx), n_samples)
+            route = window_route(lens, n_samples, density_threshold)
+            nnz = int(lens.sum())
+            with obs.span(
+                "gramian.sparse.window",
+                route=route,
+                nnz=nnz,
+                variants=int(lens.size),
+            ):
+                if route == "scatter":
+                    g = sparse_gramian_accumulate(g, window_idx, lens)
+                else:
+                    dense_width = max(width, int(lens.size))
+                    xp = pack_indicator_block(
+                        _densify_window(
+                            window_idx, lens, n_samples, dense_width
+                        )
+                    )
+                    g = gramian_accumulate_packed(g, xp)
+            _note_window(route, nnz)
+    return g

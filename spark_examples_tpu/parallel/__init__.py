@@ -19,11 +19,14 @@ Axis conventions:
 from spark_examples_tpu.parallel.mesh import make_mesh, DATA_AXIS, MODEL_AXIS
 from spark_examples_tpu.parallel.sharded import (
     SpectralGapWarning,
+    addressable_sample_bounds,
     gramian_blockwise_global,
     gramian_variant_parallel,
     gramian_variant_parallel_ring,
+    sample_bounds_of_indices,
     sharded_gramian_blockwise,
     sharded_pcoa,
+    sparse_sharded_gramian_blockwise,
     topk_eig_randomized,
 )
 from spark_examples_tpu.parallel.distributed import (
@@ -37,11 +40,14 @@ __all__ = [
     "make_mesh",
     "DATA_AXIS",
     "MODEL_AXIS",
+    "addressable_sample_bounds",
     "gramian_blockwise_global",
     "gramian_variant_parallel",
     "gramian_variant_parallel_ring",
+    "sample_bounds_of_indices",
     "sharded_gramian_blockwise",
     "sharded_pcoa",
+    "sparse_sharded_gramian_blockwise",
     "topk_eig_randomized",
     "initialize_from_env",
     "is_coordinator",

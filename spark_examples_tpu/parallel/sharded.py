@@ -21,6 +21,7 @@ Two parallelism regimes, matching SURVEY.md §2.10's strategy table:
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Iterable
 
@@ -40,17 +41,21 @@ from spark_examples_tpu.ops.pcoa import (
     SpectralGapWarning,
     check_spectral_gap,
     normalize_eigvec_signs,
+    randomized_panel_width,
 )
 from spark_examples_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 __all__ = [
     "SpectralGapWarning",
+    "addressable_sample_bounds",
     "gramian_blockwise_global",
     "gramian_variant_parallel",
     "gramian_variant_parallel_ring",
+    "sample_bounds_of_indices",
     "sharded_gramian_blockwise",
     "sharded_gramian_blockwise_global",
     "sharded_pcoa",
+    "sparse_sharded_gramian_blockwise",
     "topk_eig_randomized",
 ]
 
@@ -58,6 +63,25 @@ __all__ = [
 def _mesh_axes(mesh: Mesh):
     has_model = MODEL_AXIS in mesh.axis_names
     return DATA_AXIS, (MODEL_AXIS if has_model else None)
+
+
+def _shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` across the jax versions this tree runs on.
+
+    Newer jax exposes it at top level (with ``check_vma``); 0.4.x keeps
+    it in ``jax.experimental.shard_map`` where the same knob is spelled
+    ``check_rep``. One seam so every per-device kernel here stays
+    runnable on both.
+    """
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
 
 
 def gramian_variant_parallel(x, mesh: Mesh, compute_dtype=None):
@@ -71,7 +95,7 @@ def gramian_variant_parallel(x, mesh: Mesh, compute_dtype=None):
     )
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=P(None, DATA_AXIS),
         out_specs=P(None, None),
@@ -352,7 +376,7 @@ def gramian_variant_parallel_ring(x, mesh: Mesh, compute_dtype=None):
     perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=P(None, DATA_AXIS),
         out_specs=P(None, None),
@@ -566,6 +590,242 @@ def sharded_gramian_blockwise_global(
     )
 
 
+def sample_bounds_of_indices(index_slices, n: int):
+    """``(lo, hi)`` union of the sample ranges a tile set touches.
+
+    ``index_slices`` are the per-device ``(row_slice, col_slice)`` pairs
+    of an ``addressable_devices_indices_map`` over the (n, n) Gramian: a
+    host whose tiles cover rows R and columns C only ever reads carrier
+    indices inside ``R ∪ C`` — every pair with either endpoint outside
+    the union lands in a tile some OTHER host owns. This is the per-host
+    sample-range ingest contract (docs/ARCHITECTURE.md): ingest may
+    drop carriers outside the bounds before they ever reach the device
+    feed, bit-identically (pinned by test).
+    """
+    lo, hi = n, 0
+    for row_sl, col_sl in index_slices:
+        for sl in (row_sl, col_sl):
+            start = sl.start if sl.start is not None else 0
+            stop = sl.stop if sl.stop is not None else n
+            lo, hi = min(lo, start), max(hi, stop)
+    if hi <= lo:
+        return 0, n
+    return lo, hi
+
+
+def addressable_sample_bounds(mesh: Mesh, g_sharding, n: int):
+    """This process's sample-range bounds for a sharded (n, n) Gramian."""
+    index_map = g_sharding.addressable_devices_indices_map((n, n))
+    return sample_bounds_of_indices(index_map.values(), n)
+
+
+@functools.lru_cache(maxsize=64)
+def _sparse_tile_kernels(
+    mesh: Mesh,
+    d_axis,
+    m_axis,
+    n_padded: int,
+    tile_rows: int,
+    tile_cols: int,
+    accum_name: str,
+    compute_name: str,
+):
+    """Compiled kernel pair (tile scatter, dense fallback) for one
+    (mesh, padded-N, dtype) geometry — cached on the hashable geometry
+    key. ``jax.jit`` caches by function identity, so building these as
+    fresh closures per accumulation call would re-trace and re-compile
+    the shard_map program on EVERY call (the bench sweep's repeats and
+    per-job driver runs would measure XLA compilation, not
+    accumulation); the lru_cache pins one executable per geometry.
+    """
+    from spark_examples_tpu.ops.sparse import scatter_pairs_chunked
+
+    compute_dtype = jnp.dtype(compute_name)
+    g_sharding = NamedSharding(mesh, P(d_axis, m_axis))
+
+    def _tile_scatter(g_tile, idx):
+        # Re-base global carrier indices into this device's tile frame;
+        # anything outside the tile becomes an out-of-bounds sentinel
+        # and the drop-mode scatter ignores it. Tiles partition the
+        # (i, j) pair space, so the union over devices is exactly one
+        # +1 per co-occurring pair — the dense path's count.
+        r0 = jax.lax.axis_index(d_axis) * tile_rows
+        c0 = (
+            jax.lax.axis_index(m_axis) * tile_cols
+            if m_axis is not None
+            else 0
+        )
+        li = jnp.where(
+            (idx >= r0) & (idx < r0 + tile_rows), idx - r0, tile_rows
+        )
+        lj = jnp.where(
+            (idx >= c0) & (idx < c0 + tile_cols), idx - c0, tile_cols
+        )
+        return scatter_pairs_chunked(g_tile, li, lj)
+
+    scatter = jax.jit(
+        _shard_map(
+            _tile_scatter,
+            mesh=mesh,
+            in_specs=(P(d_axis, m_axis), P(None, None)),
+            out_specs=P(d_axis, m_axis),
+        ),
+        donate_argnums=(0,),
+    )
+
+    @partial(jax.jit, donate_argnums=(0,), out_shardings=g_sharding)
+    def _accum_dense(g, xp):
+        xb = unpack_indicator_block(xp, 8 * xp.shape[1])
+        return g + mxu_cross_product(xb, g.dtype, compute_dtype)
+
+    return scatter, _accum_dense
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _trim_square(a, n: int):
+    return a[:n, :n]
+
+
+def sparse_sharded_gramian_blockwise(
+    windows,
+    n_samples: int,
+    mesh: Mesh,
+    accum_dtype=jnp.float32,
+    density_threshold=None,
+    block_variants=None,
+    compute_dtype=None,
+):
+    """Stream CSR carrier windows into a mesh-sharded (tiled) Gramian.
+
+    The biobank-scale composition (ROADMAP item 2): G lives 2-D
+    block-sharded ``P(data, model)`` over the mesh grid — each device
+    owns one ``(N/rows, N/cols)`` tile, so N×N never materializes on any
+    single device — and each window accumulates WITHOUT densifying:
+
+    - sparse windows (density below the threshold,
+      :func:`spark_examples_tpu.ops.sparse.window_route`) scatter their
+      padded carrier matrix into every tile under ``shard_map``: each
+      device re-bases the global sample indices into its tile frame,
+      maps out-of-tile carriers to an out-of-bounds sentinel, and the
+      OOB-drop scatter accumulates exactly the pairs that land in its
+      tile. No collective at all — the carrier matrix is replicated
+      (it is ~d·N·V_blk integers, tiny next to the dense block it
+      replaces) and tiles partition the pair space.
+    - dense windows densify + bit-pack onto the existing MXU
+      accumulator with G kept in the same tiled layout (GSPMD gathers
+      the block columns; G never moves — the
+      :func:`sharded_gramian_blockwise_global` layout argument).
+
+    Both routes add exact integer counts, so the result is bit-identical
+    to the dense reference at any mesh shape and any window order
+    (pinned by tests). Ingest is restricted to this process's
+    sample-range bounds first (:func:`addressable_sample_bounds`) —
+    the per-host sample-range contract; on a single-controller mesh the
+    bounds are the full range and the restriction is a no-op.
+
+    Process-spanning meshes are not served yet: the carrier windows
+    would need the per-step width/liveness sync plus a cross-host
+    carrier allgather (cheap — carriers are sparse — but a distinct
+    protocol); use the packed dense pod path
+    (:func:`sharded_gramian_blockwise_global`) there today.
+    """
+    from spark_examples_tpu import obs
+    from spark_examples_tpu.arrays.blocks import (
+        DEFAULT_BLOCK_VARIANTS,
+        _check_indices,
+        _densify_window,
+        restrict_window_to_sample_range,
+        round_up_multiple,
+    )
+    from spark_examples_tpu.ops.sparse import (
+        DEFAULT_SPARSE_DENSITY_THRESHOLD,
+        _note_window,
+        _pad_rows_for_scan,
+        padded_carrier_matrix,
+        window_route,
+    )
+
+    if _mesh_spans_processes(mesh):
+        raise NotImplementedError(
+            "sparse sharded Gramian accumulation is single-controller "
+            "today (host-local meshes, any device count); a "
+            "process-spanning mesh needs the per-step carrier allgather "
+            "protocol — use the packed dense pod path "
+            "(sharded_gramian_blockwise_global) on pods"
+        )
+    if density_threshold is None:
+        density_threshold = DEFAULT_SPARSE_DENSITY_THRESHOLD
+    d_axis, m_axis = _mesh_axes(mesh)
+    g_sharding = NamedSharding(mesh, P(d_axis, m_axis))
+    n_padded = round_up_multiple(
+        n_samples, _axis_product(mesh, g_sharding.spec)
+    )
+    grid_rows = mesh.shape[d_axis]
+    grid_cols = mesh.shape[m_axis] if m_axis is not None else 1
+    tile_rows = n_padded // grid_rows
+    tile_cols = n_padded // grid_cols
+    lo, hi = addressable_sample_bounds(mesh, g_sharding, n_padded)
+    compute_dtype = resolve_gramian_compute_dtype(
+        jnp.int8, accum_dtype, compute_dtype
+    )
+    width = block_variants or DEFAULT_BLOCK_VARIANTS
+    scatter, _accum_dense = _sparse_tile_kernels(
+        mesh,
+        d_axis,
+        m_axis,
+        n_padded,
+        tile_rows,
+        tile_cols,
+        np.dtype(accum_dtype).name,
+        np.dtype(compute_dtype).name,
+    )
+    x_sharding = NamedSharding(mesh, P(d_axis, None))
+    idx_sharding = NamedSharding(mesh, P(None, None))
+    g = jax.device_put(
+        jnp.zeros((n_padded, n_padded), dtype=accum_dtype), g_sharding
+    )
+    with obs.span("gramian.sparse.accumulate", n=n_samples, sharded=True):
+        for window_idx, lens in windows:
+            lens = np.asarray(lens)
+            _check_indices(np.asarray(window_idx), n_samples)
+            window_idx, lens = restrict_window_to_sample_range(
+                window_idx, lens, lo, hi
+            )
+            route = window_route(lens, n_samples, density_threshold)
+            nnz = int(lens.sum())
+            with obs.span(
+                "gramian.sparse.window",
+                route=route,
+                nnz=nnz,
+                variants=int(lens.size),
+            ):
+                if route == "scatter":
+                    idx = padded_carrier_matrix(
+                        window_idx,
+                        lens,
+                        sentinel=n_padded,
+                        n_rows=_pad_rows_for_scan(lens.size),
+                    )
+                    g = scatter(g, jax.device_put(idx, idx_sharding))
+                else:
+                    dense_width = max(width, int(lens.size))
+                    xb = _densify_window(
+                        window_idx, lens, n_samples, dense_width
+                    )
+                    if n_padded != n_samples:
+                        xb = np.pad(
+                            xb, ((0, n_padded - n_samples), (0, 0))
+                        )
+                    xp = pack_indicator_block(xb)
+                    g = _accum_dense(
+                        g, jax.device_put(xp, x_sharding)
+                    )
+            _note_window(route, nnz)
+    if n_padded == n_samples:
+        return g
+    return _trim_square(g, n_samples)
+
+
 def topk_eig_randomized(
     c,
     k: int,
@@ -625,7 +885,11 @@ def topk_eig_randomized(
     report.
     """
     n = c.shape[0]
-    p = min(n, k + oversample)
+    # The k+1-values convention lives in ONE helper (ops/pcoa.py): the
+    # panel must carry a Ritz value past index k-1 or the spectral-gap
+    # check silently never fires and a flat-spectrum cohort's ambiguity
+    # goes unreported.
+    p = randomized_panel_width(n, k, oversample)
     q0 = jax.random.normal(jax.random.PRNGKey(seed), (n, p), dtype=c.dtype)
     if mesh is not None and jax.process_count() > 1:
         # Multi-controller: the panel must be a global (replicated) array

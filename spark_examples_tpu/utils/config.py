@@ -92,15 +92,28 @@ class PcaConfig(GenomicsConfig):
     min_allele_frequency: Optional[float] = None
     num_pc: int = 2
     precise: bool = False  # host-f64 eigendecomposition (driver-side LAPACK analog)
-    # Eigendecomposition route for the PCA stage. "auto" (default) runs
-    # the fused single-dispatch finish (centering + CholeskyQR subspace
-    # eig + row sums in one program, one packed readback — ops/fused.py)
-    # on single-host unsharded runs up to --dense-eigh-limit samples and
-    # the streamed/dense route everywhere else; "fused" forces the fused
-    # finish (errors on configs it cannot serve: --precise, meshes,
-    # multi-process); "stream" forces the pre-round-5 dense/randomized
-    # route.
+    # PCA pipeline route. "auto" (default) runs the fused single-dispatch
+    # finish (centering + CholeskyQR subspace eig + row sums in one
+    # program, one packed readback — ops/fused.py) on single-host
+    # unsharded runs up to --dense-eigh-limit samples, the
+    # streamed/dense route everywhere else, and the SPARSE Gramian
+    # accumulation (below) on sample-sharded host-local-mesh runs —
+    # the biobank shape; "fused" forces the fused finish (errors on
+    # configs it cannot serve: --precise, meshes, multi-process);
+    # "stream" forces the pre-round-5 dense/randomized route; "sparse"
+    # forces sparse-aware Gramian accumulation (ops/sparse.py): G
+    # accumulates by OOB-drop scatter straight from CSR carrier
+    # windows — no densify, no bit-pack, work O(Σk²) instead of
+    # O(N²·V) — 2-D tile-sharded over the mesh when one is configured,
+    # finishing through the sharded randomized eig.
     pca_mode: str = "auto"
+    # Dense/sparse switch for the sparse-aware Gramian: a window whose
+    # carrier density (nnz / (N·V_blk)) is strictly below this scatters
+    # straight from CSR; at or above it, it densifies onto the MXU
+    # path. Bit-identical either way (integer-exact both routes); the
+    # default is the measured crossover with margin (PERFORMANCE.md
+    # decision log).
+    sparse_density_threshold: float = 0.02
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 64  # shards per Gramian snapshot
     # World-size-independent checkpointing (utils/elastic.py): work units
@@ -445,13 +458,26 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument(
         "--pca-mode",
-        choices=("auto", "fused", "stream"),
+        choices=("auto", "fused", "stream", "sparse"),
         default="auto",
-        help="PCA-stage route: 'auto' (default) runs the fused single-"
+        help="PCA pipeline route: 'auto' (default) runs the fused single-"
         "dispatch finish (centering + subspace eig + row sums in one "
         "device program, one readback) on single-host unsharded runs up "
-        "to --dense-eigh-limit samples; 'fused' forces it; 'stream' "
-        "forces the dense-eigh/randomized route",
+        "to --dense-eigh-limit samples, and the sparse Gramian on "
+        "sample-sharded host-local-mesh runs; 'fused' forces the fused "
+        "finish; 'stream' forces the dense-eigh/randomized route; "
+        "'sparse' forces sparse-aware Gramian accumulation straight "
+        "from CSR carrier windows (no densify/pack, O(nnz-pairs) work, "
+        "G tile-sharded over the mesh — the biobank-scale route)",
+    )
+    p.add_argument(
+        "--sparse-density-threshold",
+        type=float,
+        default=PcaConfig.sparse_density_threshold,
+        help="Sparse-Gramian dense/sparse switch: windows with carrier "
+        "density strictly below this scatter straight from CSR, at or "
+        "above it they densify onto the MXU path; results are "
+        "bit-identical either way (integer-exact)",
     )
     p.add_argument(
         "--eig-tol",

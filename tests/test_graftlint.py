@@ -274,6 +274,19 @@ class TestSchemaSharing:
             "schema diverged — change both sides in one PR"
         )
 
+    def test_extracted_sparse_spans_match_schema_exactly(self, project):
+        schema = span_contract_mod.load_schema(REPO_ROOT)
+        extracted = {
+            name
+            for name in span_contract_mod.extract_span_names(project)
+            if name.startswith("gramian.sparse.")
+        }
+        assert extracted == set(schema._SPARSE_SPANS), (
+            "emitted gramian.sparse.* span literals and the "
+            "validate_trace schema diverged — change both sides in one "
+            "PR"
+        )
+
     def test_contract_metrics_registered_with_required_labels(self, project):
         schema = span_contract_mod.load_schema(REPO_ROOT)
         regs = span_contract_mod.extract_metric_registrations(project)
@@ -349,6 +362,35 @@ class TestSchemaSharing:
         messages = "\n".join(f.message for f in findings)
         assert "job.typo" in messages  # emitted-but-unknown direction
         assert "job.run" in messages  # schema-but-unemitted direction
+
+    def test_sparse_span_drift_is_detected(self, tmp_path):
+        """The sparse Gramian's gramian.sparse.* family gets the same
+        two-way drift gate as the ingest/job span sets."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "sparse.py").write_text(
+            "from spark_examples_tpu import obs\n\n\n"
+            "def accumulate():\n"
+            "    with obs.span('gramian.sparse.typo'):\n"
+            "        pass\n"
+        )
+        scripts = tmp_path / "scripts"
+        scripts.mkdir()
+        (scripts / "validate_trace.py").write_text(
+            "_SPARSE_SPANS = {'gramian.sparse.window'}\n"
+        )
+        lines = ["[tool.graftlint]", "exclude = []"]
+        for name in ALL_RULE_NAMES:
+            lines.append(f'[tool.graftlint.rules."{name}"]')
+            enabled = name == "span-contract"
+            lines.append(f"enabled = {'true' if enabled else 'false'}")
+            if enabled:
+                lines.append('paths = ["pkg"]')
+        (tmp_path / "pyproject.toml").write_text("\n".join(lines) + "\n")
+        findings, _ = run_lint(str(tmp_path), [])
+        messages = "\n".join(f.message for f in findings)
+        assert "gramian.sparse.typo" in messages
+        assert "gramian.sparse.window" in messages
 
 
 class TestEngineBehavior:

@@ -34,6 +34,7 @@ CODE = "GL002"
 
 DEFAULT_PATHS = (
     "spark_examples_tpu/ops/gramian.py",
+    "spark_examples_tpu/ops/sparse.py",
     "spark_examples_tpu/arrays/blocks.py",
 )
 

@@ -155,9 +155,9 @@ class SpanContractRule:
     name = NAME
     code = CODE
     summary = (
-        "spans are context-managed; ingest.*/job.* span names and "
-        "wire/ingest/serving metric registrations match "
-        "scripts/validate_trace.py exactly"
+        "spans are context-managed; ingest.*/job.*/gramian.sparse.* "
+        "span names and wire/ingest/serving/sparse metric "
+        "registrations match scripts/validate_trace.py exactly"
     )
     project_wide = True
 
@@ -193,6 +193,7 @@ class SpanContractRule:
         for prefix, attr in (
             ("ingest.", "_INGEST_SPANS"),
             ("job.", "_JOB_SPANS"),
+            ("gramian.sparse.", "_SPARSE_SPANS"),
         ):
             emitted = {n for n in span_names if n.startswith(prefix)}
             schema_spans: Set[str] = set(getattr(schema, attr, set()))
