@@ -482,10 +482,237 @@ def scale_out_sweep():
     )
 
 
+def cold_start_bench():
+    """BENCH_COLD=1: the cold-start trajectory metric (ROADMAP item 3).
+
+    Serves a fixture cohort over loopback HTTP and measures, with the
+    mirror cache EMPTIED before each timed run:
+
+    - ``cold_ingest_seconds``  — streaming cold ingest (``--cold-stream``
+      default: wire frames straight into the fetch→decode→build→put
+      pipeline, mirror written through in the background);
+    - ``phased_cold_seconds``  — the pre-cold-stream path
+      (``--no-cold-stream``: full mirror download, then ingest);
+    - ``warm_ingest_seconds``  — the same run over the completed mirror
+      (the write-through download is awaited first, so warm is truly
+      warm);
+    - ``cold_to_warm_ratio``   — the ROADMAP target tracks this ≤ 2.
+
+    Timing-honesty rule as everywhere: each ingest is timed to a host
+    readback of a G element, never a dispatch enqueue. One JSON line
+    with full backend provenance, like every other bench mode;
+    BENCH_TRACE_OUT/BENCH_METRICS_OUT/BENCH_MANIFEST_OUT emit the
+    telemetry artifacts validate_trace.py schema-checks in CI.
+    """
+    import json as _json
+    import shutil
+    import tempfile
+
+    from spark_examples_tpu import obs
+    from spark_examples_tpu.genomics import mirror as mirror_mod
+    from spark_examples_tpu.genomics.fixtures import (
+        DEFAULT_VARIANT_SET_ID,
+        synthetic_cohort,
+    )
+    from spark_examples_tpu.genomics.service import (
+        GenomicsServiceServer,
+        HttpVariantSource,
+    )
+    from spark_examples_tpu.genomics.sources import JsonlSource
+    from spark_examples_tpu.models.pca import VariantsPcaDriver
+    from spark_examples_tpu.obs.session import TelemetrySession
+    from spark_examples_tpu.utils.config import PcaConfig
+
+    fallback = _backend_guard()
+    import jax
+
+    refs = "17:41196311:41277499"
+    n = int(os.environ.get("BENCH_COLD_SAMPLES", 120))
+    v = int(os.environ.get("BENCH_COLD_VARIANTS", 2500))
+    workers = int(os.environ.get("BENCH_COLD_WORKERS", 4))
+    # Simulated wire RTT (seconds). Loopback has ~zero latency, where
+    # the phased bulk copy is legitimately competitive; the streaming
+    # cold path's win is LATENCY HIDING, so BENCH_COLD_RTT shapes the
+    # served cohort like a remote wire (per-shard RTT + throughput-
+    # shaped exports) to measure that regime on demand.
+    rtt = float(os.environ.get("BENCH_COLD_RTT", 0))
+    workdir = tempfile.mkdtemp(prefix="bench-cold-")
+    root = os.path.join(workdir, "cohort")
+    synthetic_cohort(n, v, references=refs, seed=3).dump(root)
+    local = JsonlSource(root)
+    local.ensure_serving_index()
+
+    class _LatencyShaped:
+        """Per-request RTT + per-chunk export delay, both paths."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def stream_carrying_frame(self, *args, **kwargs):
+            time.sleep(rtt)
+            return self._inner.stream_carrying_frame(*args, **kwargs)
+
+        def export_lines(self, name):
+            lines = self._inner.export_lines(name)
+
+            def gen():
+                for i, line in enumerate(lines):
+                    if i % 20 == 0:
+                        time.sleep(rtt / 2)
+                    yield line
+
+            return gen()
+
+        def ensure_sidecar(self):
+            time.sleep(5 * rtt)
+            return self._inner.ensure_sidecar()
+
+    served = _LatencyShaped(local) if rtt > 0 else local
+    server = GenomicsServiceServer(served).start()
+    url = f"http://127.0.0.1:{server.port}"
+
+    def timed_ingest(src):
+        import contextlib
+
+        conf = PcaConfig(
+            references=refs,
+            variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+            bases_per_partition=10_000,
+            ingest_workers=workers,
+        )
+        # Driver parity prints ("Matrix size: N") go to stderr here:
+        # the bench contract is ONE JSON line on stdout.
+        # The timer starts BEFORE driver construction: building the
+        # callset index resolves the mirror, which on the phased path
+        # IS the cold download — excluding it would time the phased
+        # run as if it were warm.
+        with contextlib.redirect_stdout(sys.stderr):
+            t0 = time.perf_counter()
+            drv = VariantsPcaDriver(conf, src)
+            g = drv.get_similarity_matrix_csr(drv.get_csr_fused())
+            np.asarray(g)  # host readback = the barrier
+            return time.perf_counter() - t0
+
+    def fresh_cache(tag):
+        cache = os.path.join(workdir, f"cache-{tag}")
+        shutil.rmtree(cache, ignore_errors=True)  # EMPTY before timing
+        return cache
+
+    outs = {
+        "trace_out": os.environ.get("BENCH_TRACE_OUT") or None,
+        "metrics_out": os.environ.get("BENCH_METRICS_OUT") or None,
+        "manifest_out": os.environ.get("BENCH_MANIFEST_OUT") or None,
+    }
+    # Warm the accumulate executables on the run's exact shapes FIRST:
+    # every timed run below must measure ingest, not the first-call XLA
+    # compile (which would land on whichever run went first and corrupt
+    # the cold/warm comparison).
+    timed_ingest(local)
+    try:
+        with TelemetrySession(
+            **outs,
+            command="bench-cold",
+            config={"samples": n, "variants": v, "workers": workers},
+        ):
+            cache = fresh_cache("stream")
+            src = HttpVariantSource(url, cache_dir=cache, cold_stream=True)
+            with obs.span("cold_stream_ingest"):
+                t_stream = timed_ingest(src)
+            _log(f"bench: cold streaming ingest {t_stream:.3f}s")
+            # Await the write-through mirror so warm is truly warm — and
+            # REFUSE to report a ratio if it is not: a failed/unfinished
+            # write-through would make the "warm" leg a second cold run
+            # and cold_to_warm_ratio a silent lie. If the download beat
+            # the driver's cold probe (tiny cohort over raw loopback),
+            # the source already upgraded to the mirror tier and
+            # t_stream timed a warm read labeled cold — refuse that too
+            # rather than publish it.
+            stream_mirror = src._resolve_mirror()
+            if not mirror_mod.is_cold_stream(stream_mirror):
+                raise RuntimeError(
+                    "cold streaming leg was not cold (write-through "
+                    "finished before the driver's probe); enlarge the "
+                    "workload via BENCH_COLD_SAMPLES/BENCH_COLD_VARIANTS "
+                    "or add BENCH_COLD_RTT"
+                )
+            if not stream_mirror.join(timeout=120):
+                raise RuntimeError(
+                    "write-through mirror did not complete within 120s; "
+                    "cold_to_warm_ratio would be mismeasured"
+                )
+            warm_src = HttpVariantSource(url, cache_dir=cache)
+            if warm_src.cold_stream_active():
+                raise RuntimeError(
+                    "mirror incomplete after write-through (download "
+                    "failed?); refusing to time a cold run as warm"
+                )
+            with obs.span("warm_ingest"):
+                t_warm = timed_ingest(warm_src)
+            _log(f"bench: warm ingest {t_warm:.3f}s")
+            with obs.span("phased_cold_ingest"):
+                t_phased = timed_ingest(
+                    HttpVariantSource(
+                        url,
+                        cache_dir=fresh_cache("phased"),
+                        cold_stream=False,
+                    )
+                )
+            _log(f"bench: cold phased ingest {t_phased:.3f}s")
+    finally:
+        server.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+    print(
+        _json.dumps(
+            {
+                "metric": "cold_ingest_seconds",
+                "value": round(t_stream, 4),
+                "unit": "s",
+                "cold_to_warm_ratio": round(t_stream / t_warm, 3),
+                "phased_cold_seconds": round(t_phased, 4),
+                "warm_ingest_seconds": round(t_warm, 4),
+                "vs_phased": round(t_phased / t_stream, 3),
+                "backend": (
+                    "cpu-fallback" if fallback else jax.default_backend()
+                ),
+                "provenance": {
+                    "device_count": jax.device_count(),
+                    "devices": sorted(
+                        {d.platform for d in jax.devices()}
+                    ),
+                    "transport": "http-loopback",
+                    "simulated_rtt_s": rtt,
+                    "ingest_workers": workers,
+                    "path": "cli pca --api-url ... --cache-dir ... "
+                    "--cold-stream (HttpVariantSource cold-stream tier)",
+                },
+                "note": "vs_phased compares against --no-cold-stream on "
+                "the same server; set BENCH_COLD_RTT to shape the "
+                "loopback like a remote wire (per-shard RTT + "
+                "throughput-limited exports) — the >=2x streaming bar "
+                "is enforced in tests/test_cold_stream.py",
+                "workload": {
+                    "samples": n,
+                    "variants": v,
+                    "references": refs,
+                },
+                "cache": "mirror cache EMPTIED before each cold run; "
+                "warm run awaits the write-through mirror",
+                "timing": "host-readback barrier per ingest",
+            }
+        )
+    )
+
+
 def main():
     from spark_examples_tpu import obs
     from spark_examples_tpu.obs.session import TelemetrySession
 
+    if os.environ.get("BENCH_COLD"):
+        cold_start_bench()
+        return
     if os.environ.get("BENCH_SCALE_OUT"):
         scale_out_sweep()
         return

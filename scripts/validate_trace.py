@@ -44,6 +44,8 @@ _KNOWN_PHASES = {"X", "B", "E", "i", "I", "C", "M"}
 # capture window relies on. (`ingest+gramian`, the driver STAGE name,
 # is not an `ingest.` span and is unaffected.)
 _INGEST_SPANS = {
+    "ingest.fetch",  # one shard's fetch+decode (wire frame / sidecar)
+    "ingest.stream", # the whole fused-CSR shard stream (fetch workers)
     "ingest.slice",  # CSR pairs -> per-block windows
     "ingest.build",  # window -> packed block (native scatter / numpy)
     "ingest.pack",   # legacy densified-block host pack
@@ -180,6 +182,7 @@ _INGEST_HISTOGRAM = "ingest_block_build_seconds"
 # wire/ingest metrics.
 _LABELED_COUNTERS = {
     "breaker_probe_total": "outcome",     # half-open probe outcomes
+    "cold_stream_shards_total": "stage",  # fetched/accumulated per shard
     "serving_jobs_total": "outcome",      # done/failed/cached/deduped
     "serving_shed_total": "reason",       # queue_full/quota
     "sparse_gramian_windows_total": "route",  # scatter/dense per window

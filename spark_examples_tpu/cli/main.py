@@ -115,6 +115,7 @@ def _network_source(args):
             breakers=breakers(f"grpc:{args.api_url}:"),
             cache_dir=getattr(args, "cache_dir", None),
             mirror_mode=getattr(args, "mirror_mode", "full"),
+            cold_stream=getattr(args, "cold_stream", True),
         )
     return HttpVariantSource(
         args.api_url,
@@ -123,6 +124,7 @@ def _network_source(args):
         mirror_mode=getattr(args, "mirror_mode", "full"),
         retry_policy=retry_policy,
         breakers=breakers(f"http:{args.api_url}:"),
+        cold_stream=getattr(args, "cold_stream", True),
     )
 
 

@@ -449,7 +449,10 @@ class GrpcVariantSource:
     the HTTP source has (:mod:`spark_examples_tpu.genomics.mirror` over
     the ``ExportLines``/``ExportSidecar`` RPCs) — both transports key
     mirrors by the same cohort identity, so they can share a cache
-    directory.
+    directory. ``cold_stream`` (default True) gives cold cohorts the
+    same streaming behavior as the HTTP source: shard frames ride the
+    wire immediately while the mirror downloads write-through in the
+    background.
     """
 
     def __init__(
@@ -464,6 +467,7 @@ class GrpcVariantSource:
         cache_dir: Optional[str] = None,
         mirror_mode: str = "full",
         wire_frames: bool = True,
+        cold_stream: bool = True,
     ):
         import threading
 
@@ -479,6 +483,7 @@ class GrpcVariantSource:
             target = target[len("grpc://"):]
         self._cache_dir = cache_dir
         self._mirror_mode = mirror_mode
+        self._cold_stream = cold_stream
         self._mirror = None  # resolved lazily: JsonlSource | False | None
         self._mirror_lock = threading.Lock()
         from spark_examples_tpu.genomics.wire import OrdinalLookupCache
@@ -553,8 +558,24 @@ class GrpcVariantSource:
                 self._cache_dir,
                 self._mirror_mode,
                 self.stats,
+                cold_stream=self._cold_stream,
             )
             return self._mirror
+
+    def cold_stream_active(self) -> bool:
+        """Is this run streaming a COLD cohort from the wire while the
+        mirror downloads write-through in the background? (Same
+        contract as the HTTP source's method — the shared run-boundary
+        tier-upgrade body is
+        :func:`spark_examples_tpu.genomics.mirror.refresh_cold_stream`.)"""
+        from spark_examples_tpu.genomics import mirror as mirror_mod
+
+        return mirror_mod.refresh_cold_stream(self)
+
+    def _note_cold_shard_fetched(self) -> None:
+        from spark_examples_tpu.genomics import mirror as mirror_mod
+
+        mirror_mod.note_cold_shard_fetched(self._mirror)
 
     # -- binary frame tier ---------------------------------------------------
 
@@ -1189,10 +1210,12 @@ class GrpcVariantSource:
                 variant_set_id, shard, indexes, min_allele_frequency
             )
         if self._frame_order_ids():
-            return self._frame_carrying_csr(
+            pair = self._frame_carrying_csr(
                 variant_set_id, shard, indexes, min_allele_frequency
             )
-        return csr_pair_from_lists(
+            self._note_cold_shard_fetched()
+            return pair
+        pair = csr_pair_from_lists(
             _carrying_records(
                 self._wire_variant_records(variant_set_id, shard),
                 indexes,
@@ -1201,6 +1224,8 @@ class GrpcVariantSource:
                 min_allele_frequency,
             )
         )
+        self._note_cold_shard_fetched()
+        return pair
 
 
 class _GrpcMirrorFeed:

@@ -607,6 +607,14 @@ class HttpVariantSource:
       streaming. (The mirror protocol itself is transport-agnostic —
       :mod:`spark_examples_tpu.genomics.mirror` — and shared with the
       gRPC source.)
+    - ``cold_stream`` (default True, CLI ``--cold-stream``): on a COLD
+      cohort (cache_dir set, no completed mirror) the source does NOT
+      block on the mirror download — shard requests ride the wire
+      tiers immediately while the mirror downloads write-through on a
+      background thread (atomic per-file; partial downloads are reused
+      by the next cold run). ``cold_stream=False`` restores the phased
+      behavior: the first call downloads the whole mirror, then serves
+      from it.
     """
 
     def __init__(
@@ -620,6 +628,7 @@ class HttpVariantSource:
         retry_policy=None,
         breakers=None,
         wire_frames: bool = True,
+        cold_stream: bool = True,
     ):
         if mirror_mode not in ("full", "light"):
             raise ValueError(
@@ -634,6 +643,7 @@ class HttpVariantSource:
         self._timeout = timeout
         self._cache_dir = cache_dir
         self._mirror_mode = mirror_mode
+        self._cold_stream = cold_stream
         # Declarative failure handling (resilience/policy.py): every
         # request runs under the policy — transport errors and
         # infrastructural statuses (429/502/503/504...) retry with
@@ -819,8 +829,29 @@ class HttpVariantSource:
                 self._cache_dir,
                 self._mirror_mode,
                 self.stats,
+                cold_stream=self._cold_stream,
             )
             return self._mirror
+
+    def cold_stream_active(self) -> bool:
+        """Is this run streaming a COLD cohort from the wire while the
+        mirror downloads write-through in the background? (With
+        cold-stream enabled, resolves the mirror — one /identity
+        round-trip — if not yet resolved; with ``--no-cold-stream``
+        this is a flag probe only, so the phased download still happens
+        lazily inside the per-shard retry seam. The
+        driver consults this before choosing its ingest order. The
+        run-boundary tier-upgrade semantics live in
+        :func:`spark_examples_tpu.genomics.mirror.refresh_cold_stream`,
+        shared with the gRPC source.)"""
+        from spark_examples_tpu.genomics import mirror as mirror_mod
+
+        return mirror_mod.refresh_cold_stream(self)
+
+    def _note_cold_shard_fetched(self) -> None:
+        from spark_examples_tpu.genomics import mirror as mirror_mod
+
+        mirror_mod.note_cold_shard_fetched(self._mirror)
 
     # -- binary frame tier --------------------------------------------------
 
@@ -1130,15 +1161,17 @@ class HttpVariantSource:
                 variant_set_id, shard, indexes, min_allele_frequency
             )
         if self._frame_order_ids():
-            return self._frame_carrying_csr(
+            pair = self._frame_carrying_csr(
                 variant_set_id, shard, indexes, min_allele_frequency
             )
+            self._note_cold_shard_fetched()
+            return pair
         from spark_examples_tpu.genomics.sources import (
             _carrying_records,
             csr_pair_from_lists,
         )
 
-        return csr_pair_from_lists(
+        pair = csr_pair_from_lists(
             _carrying_records(
                 self._wire_variant_records(variant_set_id, shard),
                 indexes,
@@ -1147,6 +1180,8 @@ class HttpVariantSource:
                 min_allele_frequency,
             )
         )
+        self._note_cold_shard_fetched()
+        return pair
 
     def stream_carrying_keyed(
         self,

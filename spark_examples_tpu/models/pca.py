@@ -76,15 +76,16 @@ class VariantsPcaDriver:
             raise ValueError(
                 "--elastic-checkpoint requires --checkpoint-dir"
             )
-        if getattr(conf, "ingest_order", "manifest") not in (
+        if getattr(conf, "ingest_order", "auto") not in (
+            "auto",
             "manifest",
             "completion",
         ):
             # argparse choices only guard the CLI (same reasoning as
             # pca_mode below).
             raise ValueError(
-                f"ingest_order must be 'manifest' or 'completion'; got "
-                f"{conf.ingest_order!r}"
+                f"ingest_order must be 'auto', 'manifest', or "
+                f"'completion'; got {conf.ingest_order!r}"
             )
         if getattr(conf, "prefetch_depth", 2) < 1:
             # A zero/negative staging depth would deadlock the bounded
@@ -421,6 +422,14 @@ class VariantsPcaDriver:
             and not self.conf.speculative_ingest
         )
 
+    def _cold_stream_active(self) -> bool:
+        """Is the source streaming a COLD remote cohort from the wire
+        while its mirror downloads write-through in the background?
+        (Sources without the concept — local sidecars, fixtures —
+        answer False.)"""
+        probe = getattr(self.source, "cold_stream_active", None)
+        return bool(probe()) if probe is not None else False
+
     def get_csr_fused(self):
         """Fused single-dataset ingest as per-shard CSR pairs — the
         vectorized twin of :meth:`get_calls_fused` (same filters and
@@ -437,7 +446,22 @@ class VariantsPcaDriver:
         bit-identical under any arrival order — pinned by test. Block
         COMPOSITION differs, which is why checkpointed modes (snapshot
         digests cut at manifest positions) always keep manifest order.
+
+        COLD-STREAM runs (``--cold-stream`` on a cold remote cohort)
+        default to completion order: the whole point of the streaming
+        cold path is that fetch → decode → build → put runs as one
+        completion-ordered pipeline per shard with no inter-phase
+        barrier, so the device accumulator starts before the last shard
+        is off the wire. Each per-shard fetch+decode is an
+        ``ingest.fetch`` span and the whole stream an ``ingest.stream``
+        span (with the ``ingest.stream`` fault seam inside the per-
+        shard retry loop — a mid-pipeline stall/error/truncate retries
+        per ``--shard-retries`` and G stays bit-identical, pinned by
+        the chaos tests).
         """
+        from spark_examples_tpu import obs
+        from spark_examples_tpu.genomics.mirror import tick_cold_stream_shard
+        from spark_examples_tpu.resilience import faults
         from spark_examples_tpu.utils.concurrency import (
             completion_parallel_map,
             ordered_parallel_map,
@@ -449,25 +473,45 @@ class VariantsPcaDriver:
             print(
                 f"Min allele frequency {self.conf.min_allele_frequency}."
             )
+        cold = self._cold_stream_active()
+        order = getattr(self.conf, "ingest_order", "auto")
+        if order == "auto":
+            # An EXPLICIT --ingest-order is always honored; only the
+            # default resolves by run shape.
+            order = "completion" if cold else "manifest"
+            if cold:
+                print(
+                    "Cold-stream ingest: completion-ordered "
+                    "fetch-decode-build-put pipeline (mirror writes "
+                    "through in the background).",
+                    file=sys.stderr,
+                )
 
         def extract(shard):
-            return self._shard_attempt(
-                shard,
-                lambda: self.source.stream_carrying_csr(
-                    vsid,
-                    shard,
-                    self.index.indexes,
-                    self.conf.min_allele_frequency,
-                ),
-            )
+            def fetch():
+                faults.inject("ingest.stream", key=str(shard))
+                with obs.span("ingest.fetch", shard=str(shard)):
+                    return self.source.stream_carrying_csr(
+                        vsid,
+                        shard,
+                        self.index.indexes,
+                        self.conf.min_allele_frequency,
+                    )
+
+            return self._shard_attempt(shard, fetch)
 
         pmap = (
             completion_parallel_map
-            if getattr(self.conf, "ingest_order", "manifest")
-            == "completion"
+            if order == "completion"
             else ordered_parallel_map
         )
-        yield from pmap(extract, shards, self._ingest_workers())
+        with obs.span(
+            "ingest.stream", shards=len(shards), order=order, cold=cold
+        ):
+            for pair in pmap(extract, shards, self._ingest_workers()):
+                if cold:
+                    tick_cold_stream_shard("accumulated")
+                yield pair
 
     def _fused_multi_possible(self) -> bool:
         """Keyed fused ingest for multi-dataset join/merge: identity

@@ -298,9 +298,14 @@ def gramian_blockwise(
                             jnp.int8, g.dtype, compute_dtype
                         ),
                     )
-                g = gramian_accumulate_packed(
-                    g, xp, compute_dtype=compute_dtype
-                )
+                # One span per accumulation DISPATCH (async; ~µs): its
+                # start is the cold-stream acceptance anchor — the
+                # first accumulate must begin while later shards are
+                # still inside their ingest.fetch spans.
+                with obs.span("gramian.accumulate", block=i):
+                    g = gramian_accumulate_packed(
+                        g, xp, compute_dtype=compute_dtype
+                    )
         return g
     with obs.span("gramian_blockwise", packed=False):
         for i, xb in enumerate(
@@ -316,5 +321,6 @@ def gramian_blockwise(
                         xb.dtype, g.dtype, compute_dtype
                     ),
                 )
-            g = gramian_accumulate(g, xb, compute_dtype=compute_dtype)
+            with obs.span("gramian.accumulate", block=i):
+                g = gramian_accumulate(g, xb, compute_dtype=compute_dtype)
     return g

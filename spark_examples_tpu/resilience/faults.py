@@ -40,6 +40,13 @@ Sites wired in this round (glob-matched, so ``transport.*`` works):
 ``transport.oauth.request`` before each token-exchange attempt
 ``ingest.shard``            driver-side shard extraction (error = worker
                             death mid-stream, stall = slow lane)
+``ingest.stream``           fused-CSR streaming ingest, per shard inside
+                            the retry loop (error/stall/truncate = a
+                            fetch-decode-build-put pipeline fault
+                            mid-stream; retried per --shard-retries)
+``mirror.write``            cohort-mirror file commit (torn = kill -9
+                            mid-write: the tmp truncates to half and
+                            never renames; error/stall as usual)
 ``checkpoint.snapshot_write``  Gramian snapshot save (torn/error/stall)
 ``checkpoint.lane_write``      elastic lane save (torn/error/stall)
 ``checkpoint.lane_supersede``  crash between lane write and stale-lane
@@ -70,6 +77,7 @@ __all__ = [
     "clear_plan",
     "current_plan",
     "inject",
+    "inject_write",
     "install_plan",
     "plan_from_env",
     "take",
@@ -313,6 +321,36 @@ def inject(site: str, key: str = "", plan: Optional[FaultPlan] = None) -> None:
         time.sleep(rule.stall_s)
         return
     raise InjectedFault(site, rule.kind, key, rule.message)
+
+
+def inject_write(
+    site: str, path: str, plan: Optional[FaultPlan] = None
+) -> None:
+    """Write-seam injection point for tmp-then-atomic-rename protocols
+    (the mirror's ``mirror.write``): ``torn`` truncates the half-written
+    tmp file to half its bytes AND raises — the kill -9-mid-write shape,
+    where the commit rename must never run and the partial can only
+    ever exist under a ``*.tmp-*`` name; ``stall`` sleeps; anything
+    else raises. No-op without a plan. (The checkpoint seams keep
+    their own torn shape — truncate-after-commit — because their
+    tolerant loaders are the defense under test there.)"""
+    rule = take(site, key=os.path.basename(path), plan=plan)
+    if rule is None:
+        return
+    if rule.kind == "torn":
+        try:
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(max(1, size // 2))
+        except OSError:
+            pass
+        raise InjectedFault(
+            site, "torn", os.path.basename(path), rule.message
+        )
+    if rule.kind == "stall":
+        time.sleep(rule.stall_s)
+        return
+    raise InjectedFault(site, rule.kind, os.path.basename(path), rule.message)
 
 
 def wrap_lines(

@@ -57,35 +57,89 @@ def completion_parallel_map(
     consumer's output depends on element order (block packing for
     checkpoint digests, printed records).
 
+    NO INTER-PHASE BARRIER: ``items`` is pulled by a dedicated feeder
+    thread, so a completed result reaches the consumer the moment it
+    finishes even while the items iterator itself is BLOCKED producing
+    the next element. The pre-cold-stream implementation pulled items
+    and drained results on one thread, which parked finished work
+    behind a slow upstream (a wire fetch between windows) — exactly
+    the phase barrier the streaming cold path exists to remove; the
+    acceptance test pins the overlap on the trace timeline.
+
     A worker exception surfaces at the point it is DRAINED (not at the
-    failed item's submission position); remaining in-flight work is
-    abandoned to the executor's shutdown, like the ordered map.
+    failed item's submission position); an items-iterator exception
+    surfaces after the results already in flight; remaining in-flight
+    work is abandoned to the executor's shutdown, like the ordered map.
     """
     if workers <= 1:
         for item in items:
             yield fn(item)
         return
 
-    from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+    import queue as _queue
+    import threading
+    from concurrent.futures import Future, ThreadPoolExecutor
 
     window = workers + max(0, lookahead)
+    done_q: _queue.Queue = _queue.Queue()
+    slots = threading.Semaphore(window)  # bounds results in flight
+    stop = threading.Event()
+    _END = object()
+    state = {"submitted": 0}
+    pending: set = set()
+    plock = threading.Lock()
+
     with ThreadPoolExecutor(max_workers=workers) as pool:
-        pending = set()
+
+        def feed() -> None:
+            try:
+                for item in items:
+                    slots.acquire()
+                    if stop.is_set():
+                        return
+                    fut = pool.submit(fn, item)
+                    state["submitted"] += 1
+                    with plock:
+                        pending.add(fut)
+
+                    def _done(f) -> None:
+                        with plock:
+                            pending.discard(f)
+                        done_q.put(f)
+
+                    fut.add_done_callback(_done)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                done_q.put(e)
+            finally:
+                done_q.put(_END)
+
+        feeder = threading.Thread(
+            target=feed, name="completion-map-feed", daemon=True
+        )
+        feeder.start()
+        end_seen = False
+        yielded = 0
         try:
-            for item in items:
-                pending.add(pool.submit(fn, item))
-                while len(pending) >= window:
-                    done, pending = wait(
-                        pending, return_when=FIRST_COMPLETED
-                    )
-                    for fut in done:
-                        yield fut.result()
-            while pending:
-                done, pending = wait(pending, return_when=FIRST_COMPLETED)
-                for fut in done:
-                    yield fut.result()
+            while not (end_seen and yielded == state["submitted"]):
+                got = done_q.get()
+                if got is _END:
+                    end_seen = True
+                    continue
+                if isinstance(got, Future):
+                    slots.release()
+                    yielded += 1
+                    yield got.result()
+                else:
+                    raise got  # the items iterator itself failed
         finally:
-            for fut in pending:
+            stop.set()
+            slots.release()  # unblock a feeder parked on a full window
+            with plock:
+                leftover = list(pending)
+            # Cancel OUTSIDE plock: cancelling a not-yet-started future
+            # runs its done callbacks inline on this thread, and _done
+            # re-acquires plock — holding it here self-deadlocks.
+            for fut in leftover:
                 fut.cancel()
 
 

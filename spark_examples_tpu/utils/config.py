@@ -159,8 +159,11 @@ class PcaConfig(GenomicsConfig):
     # it. G is bit-identical either way (integer-exact accumulation —
     # pinned by test); only block composition and wall-clock change.
     # Checkpointed modes keep manifest order (snapshot digests cut at
-    # manifest positions).
-    ingest_order: str = "manifest"
+    # manifest positions). "auto" (the default) resolves to completion
+    # on a cold-stream run (the streaming cold path exists to remove
+    # arrival-order barriers) and manifest everywhere else; an EXPLICIT
+    # manifest/completion is always honored, cold or warm.
+    ingest_order: str = "auto"
     # Spark-style speculative execution for straggler shards: when the
     # head-of-line extraction runs far past the median, a duplicate
     # attempt races it and the winner's (identical) result is used.
@@ -224,6 +227,20 @@ def add_genomics_flags(p: argparse.ArgumentParser) -> None:
         "~2.7 GB npz instead of a ~58 GB JSONL, serving the default "
         "fused pca ingest tiers (record-streaming consumers like "
         "--debug-datasets need 'full')",
+    )
+    p.add_argument(
+        "--cold-stream",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="With --cache-dir on a COLD cohort (no completed mirror): "
+        "stream wire frames straight into the fetch->decode->build->put "
+        "ingest pipeline — the first Gramian step dispatches while later "
+        "shards are still on the wire — and write the mirror through in "
+        "the background (atomic per-file; a killed run's partial mirror "
+        "is reused by the next cold run). --no-cold-stream restores the "
+        "phased cold path (download the whole mirror, then ingest). "
+        "Warm runs and checkpointed/mesh contracts are unaffected; G is "
+        "bit-identical either way",
     )
     p.add_argument(
         "--input-path",
@@ -363,13 +380,14 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument(
         "--ingest-order",
-        choices=("manifest", "completion"),
+        choices=("auto", "manifest", "completion"),
         default=PcaConfig.ingest_order,
         help="Shard arrival order into the Gramian accumulator on the "
-        "CSR-direct ingest tier: 'manifest' (default) preserves exact "
-        "manifest order; 'completion' feeds shards as their "
-        "fetch+decode completes — the remote binary-frame tier's "
-        "throughput mode, where a slow shard never stalls the device. "
+        "CSR-direct ingest tier: 'manifest' preserves exact manifest "
+        "order; 'completion' feeds shards as their fetch+decode "
+        "completes — the remote binary-frame tier's throughput mode, "
+        "where a slow shard never stalls the device; 'auto' (default) "
+        "picks completion on cold-stream runs and manifest otherwise. "
         "G is bit-identical either way (integer-exact accumulation); "
         "checkpointed runs always use manifest order",
     )

@@ -120,6 +120,81 @@ def test_device_prefetch_consumer_cancel_at_depth_gt_2():
     assert stable_at is not None and stable_at < 1000
 
 
+def test_device_prefetch_cancel_while_producer_blocked_in_fetch():
+    """Consumer cancellation against an UNBOUNDED-LATENCY producer (the
+    cold-stream shape: a shard fetch that may take arbitrarily long on
+    a slow wire). close() must return promptly even though the producer
+    thread is parked INSIDE its fetch, and once the fetch finally
+    returns the producer must notice the cancel and stop — no further
+    frames drawn, no thread leak."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from spark_examples_tpu.arrays.feed import device_prefetch
+
+    in_fetch = threading.Event()
+    release = threading.Event()
+    produced = []
+
+    def frames():
+        produced.append(0)
+        yield np.zeros((8, 8), np.int8)
+        in_fetch.set()
+        # The unbounded-latency fetch (bounded here only so a failing
+        # implementation can't hang the suite).
+        release.wait(30)
+        produced.append(1)
+        yield np.zeros((8, 8), np.int8)
+        produced.append(2)
+        yield np.zeros((8, 8), np.int8)
+
+    it = device_prefetch(frames(), depth=2)
+    next(it)
+    assert in_fetch.wait(5)
+    t0 = time.monotonic()
+    it.close()  # cancel while frame 2 is still "on the wire"
+    assert time.monotonic() - t0 < 1.0  # close never waits on the fetch
+    release.set()  # the slow fetch lands AFTER the cancel
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(produced) < 2:
+        time.sleep(0.05)
+    time.sleep(0.3)
+    # The in-flight fetch may complete (it was already running) but the
+    # producer must stop there: frame 3 is never drawn.
+    assert len(produced) <= 2
+
+
+def test_device_prefetch_producer_exception_with_frames_in_flight():
+    """A producer that dies while staged frames are still in flight
+    (queued, unconsumed — the consumer hasn't even started draining):
+    every already-staged frame must still be delivered, in order, and
+    the failure must surface AFTER them — never a silent drop, never a
+    lost exception."""
+    import time
+
+    import numpy as np
+    import pytest
+
+    from spark_examples_tpu.arrays.feed import device_prefetch
+
+    def failing():
+        for i in range(3):
+            yield np.full((4, 4), i, np.int8)
+        raise IOError("wire fetch died mid-stream")
+
+    it = device_prefetch(failing(), depth=3)
+    # Let the producer fill the queue AND die before the consumer takes
+    # a single frame — the frames are "in flight" when the error lands.
+    time.sleep(0.5)
+    got = []
+    with pytest.raises(IOError, match="wire fetch died mid-stream"):
+        for b in it:
+            got.append(int(np.asarray(b)[0, 0]))
+    assert got == [0, 1, 2]
+
+
 def test_int8_int32_gramian_exact():
     """int8 x int8 -> int32 einsum (the MXU int-matmul path) is exact and
     matches the f32 path."""

@@ -1253,6 +1253,7 @@ class TestLightMirrorUpgradeReverify:
             light = HttpVariantSource(
                 f"http://127.0.0.1:{server.port}",
                 cache_dir=url_cache,
+                cold_stream=False,
                 mirror_mode="light",
             )
             indexes = {
@@ -1297,6 +1298,7 @@ class TestLightMirrorUpgradeReverify:
             full = HttpVariantSource(
                 f"http://127.0.0.1:{server2.port}",
                 cache_dir=url_cache,
+                cold_stream=False,
                 mirror_mode="full",
             )
             with pytest.raises(IOError, match="upgrading"):

@@ -326,7 +326,7 @@ class TestSidecarExport:
             )
             url = f"http://127.0.0.1:{server.port}"
             client = HttpVariantSource(
-                url, cache_dir=str(tmp_path / "cache")
+                url, cache_dir=str(tmp_path / "cache"), cold_stream=False
             )
             shards = shards_for_references(self.REFS, 30_000)
             got = self._carrying(client, shards)
@@ -354,7 +354,7 @@ class TestSidecarExport:
             assert jsonl.ensure_sidecar() is not None
             url = f"http://127.0.0.1:{server.port}"
             client = HttpVariantSource(
-                url, cache_dir=str(tmp_path / "cache")
+                url, cache_dir=str(tmp_path / "cache"), cold_stream=False
             )
             shards = shards_for_references(self.REFS, 30_000)
             self._carrying(client, shards)  # populate the mirror
@@ -383,7 +383,7 @@ class TestSidecarExport:
         try:
             url = f"http://127.0.0.1:{server.port}"
             client = HttpVariantSource(
-                url, cache_dir=str(tmp_path / "cache")
+                url, cache_dir=str(tmp_path / "cache"), cold_stream=False
             )
             shards = shards_for_references(self.REFS, 30_000)
             got = self._carrying(client, shards)
@@ -411,7 +411,9 @@ class TestMirrorCache:
             url = f"http://127.0.0.1:{server.port}"
             shards = shards_for_references(REFS, 20_000)
 
-            first = HttpVariantSource(url, cache_dir=str(tmp_path))
+            first = HttpVariantSource(
+                url, cache_dir=str(tmp_path), cold_stream=False
+            )
             got1 = [
                 v
                 for s in shards
@@ -421,7 +423,9 @@ class TestMirrorCache:
             assert counting.exports > 0
 
             counting.exports = 0
-            second = HttpVariantSource(url, cache_dir=str(tmp_path))
+            second = HttpVariantSource(
+                url, cache_dir=str(tmp_path), cold_stream=False
+            )
             got2 = [
                 v
                 for s in shards
@@ -440,7 +444,7 @@ class TestMirrorCache:
             url = f"http://127.0.0.1:{server.port}"
             shards = shards_for_references(REFS, 20_000)
             cached = HttpVariantSource(
-                url, cache_dir=str(tmp_path / "cache")
+                url, cache_dir=str(tmp_path / "cache"), cold_stream=False
             )
             inner.dump(str(tmp_path / "local"))
             local = JsonlSource(str(tmp_path / "local"))
@@ -458,7 +462,9 @@ class TestMirrorCache:
         try:
             url = f"http://127.0.0.1:{server.port}"
             shard = shards_for_references(REFS, 100_000)[0]
-            a = HttpVariantSource(url, cache_dir=str(tmp_path))
+            a = HttpVariantSource(
+                url, cache_dir=str(tmp_path), cold_stream=False
+            )
             n_before = len(
                 list(a.stream_variants(DEFAULT_VARIANT_SET_ID, shard))
             )
@@ -469,7 +475,9 @@ class TestMirrorCache:
         try:
             url = f"http://127.0.0.1:{server2.port}"
             shard = shards_for_references(REFS, 100_000)[0]
-            b = HttpVariantSource(url, cache_dir=str(tmp_path))
+            b = HttpVariantSource(
+                url, cache_dir=str(tmp_path), cold_stream=False
+            )
             got = list(b.stream_variants(DEFAULT_VARIANT_SET_ID, shard))
             want = list(
                 inner2.stream_variants(DEFAULT_VARIANT_SET_ID, shard)
@@ -505,7 +513,9 @@ class TestMirrorCache:
             server2 = GenomicsServiceServer(Opaque()).start()
             try:
                 url = f"http://127.0.0.1:{server2.port}"
-                http = HttpVariantSource(url, cache_dir=str(tmp_path))
+                http = HttpVariantSource(
+                    url, cache_dir=str(tmp_path), cold_stream=False
+                )
                 shard = shards_for_references(REFS, 100_000)[0]
                 assert (
                     len(list(http.stream_variants("", shard))) == 10
@@ -655,6 +665,7 @@ class TestLightMirror:
             http = HttpVariantSource(
                 f"http://127.0.0.1:{server.port}",
                 cache_dir=str(tmp_path / "cache"),
+                cold_stream=False,
                 mirror_mode="light",
             )
             remote = VariantsPcaDriver(conf, http).run()
@@ -683,6 +694,7 @@ class TestLightMirror:
             http2 = HttpVariantSource(
                 f"http://127.0.0.1:{server2.port}",
                 cache_dir=str(tmp_path / "cache"),
+                cold_stream=False,
                 mirror_mode="light",
             )
             remote2 = VariantsPcaDriver(conf, http2).run()
@@ -706,6 +718,7 @@ class TestLightMirror:
             http = HttpVariantSource(
                 f"http://127.0.0.1:{server.port}",
                 cache_dir=str(tmp_path / "cache"),
+                cold_stream=False,
                 mirror_mode="light",
             )
             with pytest.raises(IOError, match="light mirror"):
@@ -766,7 +779,7 @@ class TestLightMirrorUpgrade:
             url = f"http://127.0.0.1:{server.port}"
             cache = str(tmp_path / "cache")
             light = HttpVariantSource(
-                url, cache_dir=cache, mirror_mode="light"
+                url, cache_dir=cache, mirror_mode="light", cold_stream=False
             )
             shard = shards_for_references(REFS, 20_000)[0]
             indexes = {
@@ -791,7 +804,7 @@ class TestLightMirrorUpgrade:
             )
             # Full-mode consumer over the same cache: upgrade + records.
             full = HttpVariantSource(
-                url, cache_dir=cache, mirror_mode="full"
+                url, cache_dir=cache, mirror_mode="full", cold_stream=False
             )
             got = list(
                 full.stream_variants(DEFAULT_VARIANT_SET_ID, shard)
@@ -824,7 +837,7 @@ class TestLightMirrorUpgrade:
             url = f"http://127.0.0.1:{server.port}"
             cache = str(tmp_path / "cache")
             light = HttpVariantSource(
-                url, cache_dir=cache, mirror_mode="light"
+                url, cache_dir=cache, mirror_mode="light", cold_stream=False
             )
             shard = shards_for_references(REFS, 20_000)[0]
             indexes = {
@@ -839,7 +852,7 @@ class TestLightMirrorUpgrade:
                 )
             )
             light2 = HttpVariantSource(
-                url, cache_dir=cache, mirror_mode="light"
+                url, cache_dir=cache, mirror_mode="light", cold_stream=False
             )
             with pytest.raises(FileNotFoundError, match="LIGHT"):
                 list(

@@ -519,6 +519,45 @@ class TestCrossTierBitIdentity:
         with pytest.raises(ValueError, match="x7"):
             list(completion_parallel_map(boom, range(20), workers=4))
 
+    def test_completion_parallel_map_error_with_queued_work_no_deadlock(
+        self,
+    ):
+        """Teardown with QUEUED-UNSTARTED futures must not deadlock:
+        cancelling a pending future runs its done callback inline on
+        the cancelling thread, so the cleanup path must never hold the
+        pending-set lock across cancel(). Regression for the cold-
+        stream feeder rewrite — one instant failure while slow items
+        saturate the two workers pins queued futures at drain time."""
+        import threading
+        import time
+
+        from spark_examples_tpu.utils.concurrency import (
+            completion_parallel_map,
+        )
+
+        def fn(x):
+            if x == 0:
+                raise ValueError("x0")
+            time.sleep(0.3)
+            return x
+
+        outcome: list = []
+
+        def run() -> None:
+            try:
+                list(completion_parallel_map(fn, range(8), workers=2))
+            except ValueError as e:
+                outcome.append(e)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(20.0)
+        assert not t.is_alive(), (
+            "completion_parallel_map deadlocked tearing down with "
+            "queued-unstarted futures"
+        )
+        assert outcome and "x0" in str(outcome[0])
+
 
 class TestPerfAcceptance:
     """Loopback fixture measurement: the binary frame tier must beat
@@ -754,7 +793,9 @@ class TestGrpcFrameTier:
         shards = shards_for_references(REFS, 15_000)
         local = JsonlSource(root)
 
-        rpc = GrpcVariantSource(target, cache_dir=cache, mirror_mode="light")
+        rpc = GrpcVariantSource(
+            target, cache_dir=cache, mirror_mode="light", cold_stream=False
+        )
         try:
             for shard in shards:
                 want = local.stream_carrying_csr(VSID, shard, indexes)
@@ -769,7 +810,7 @@ class TestGrpcFrameTier:
 
         # Second client: identity probe + mirror hit, then pure local.
         rpc2 = GrpcVariantSource(
-            target, cache_dir=cache, mirror_mode="light"
+            target, cache_dir=cache, mirror_mode="light", cold_stream=False
         )
         try:
             before = rpc2.stats.requests
