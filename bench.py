@@ -450,6 +450,7 @@ def scale_out_sweep():
             f"bench: scale-out N={n} {t:.3f}s "
             f"({sweep[-1]['nnz_per_sec']:.0f} nnz/s)"
         )
+    pod = _pod_sparse_leg(carriers, block_v)
     largest = sweep[-1]
     print(
         _json.dumps(
@@ -473,6 +474,7 @@ def scale_out_sweep():
                     "(cli pca --pca-mode sparse)",
                 },
                 "sweep": sweep,
+                "pod": pod,
                 "workload": "rare-variant CSR cohort, fixed "
                 "carriers-per-variant (density falls as 1/N — the "
                 "biobank AF shape)",
@@ -480,6 +482,169 @@ def scale_out_sweep():
             }
         )
     )
+
+
+_POD_SPARSE_BENCH_WORKER = '''
+import json, os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from jax.sharding import Mesh
+
+from spark_examples_tpu.parallel.distributed import initialize_from_env
+assert initialize_from_env()
+from spark_examples_tpu.arrays.blocks import csr_windows
+from spark_examples_tpu.parallel.sharded import (
+    sparse_sharded_gramian_blockwise,
+)
+
+out, n, carriers, n_variants, block_v, repeat = (
+    sys.argv[1],
+    int(sys.argv[2]),
+    int(sys.argv[3]),
+    int(sys.argv[4]),
+    int(sys.argv[5]),
+    int(sys.argv[6]),
+)
+pid, world = jax.process_index(), jax.process_count()
+mesh = Mesh(np.array(jax.devices()).reshape(world, 2), ("data", "model"))
+
+# Same rare-variant CSR cohort as the single-controller sweep, drawn
+# directly in CSR; every process derives the identical cohort and
+# slices its own windows round-robin (the manifest-slice shape).
+rng = np.random.default_rng(0)
+k = min(carriers, n)
+idx = np.empty(n_variants * k, dtype=np.int64)
+for v in range(n_variants):
+    idx[v * k : (v + 1) * k] = rng.choice(n, size=k, replace=False)
+offsets = np.arange(n_variants + 1, dtype=np.int64) * k
+windows = list(csr_windows(iter([(idx, offsets)]), block_v))
+mine = windows[pid::world]
+readback = jax.jit(lambda a: a.ravel()[:1])
+
+
+def run():
+    g = sparse_sharded_gramian_blockwise(
+        iter(mine), n, mesh, block_variants=block_v
+    )
+    np.asarray(readback(g))  # host readback = the barrier
+
+
+run()  # warm: compile + allocator
+times = []
+for _ in range(repeat):
+    t0 = time.perf_counter()
+    run()
+    times.append(time.perf_counter() - t0)
+if pid == 0:
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "seconds": min(times),
+                "nnz": int(offsets[-1]),
+                "n": n,
+                "variants": n_variants,
+                "device_count": jax.device_count(),
+                "mesh": {"data": world, "model": 2},
+            },
+            f,
+        )
+'''
+
+
+def _pod_sparse_leg(carriers: int, block_v: int):
+    """The pod-sparse scale-out leg: the same rare-variant sweep
+    through ``sparse_sharded_gramian_blockwise`` on a REAL
+    ``jax.distributed`` multi-process CPU mesh (the carrier-allgather
+    protocol), so the multichip trajectory tracks the pod route like
+    r01–r05 tracked the host-local one. BENCH_SCALE_PROCESSES sets the
+    process count (default 2; 0 disables), BENCH_SCALE_POD_N the
+    cohort size (default 2048). Returns the pod sample dict (with
+    process-count + mesh provenance) or an ``{"error": ...}`` record
+    on hosts whose backend lacks multi-process CPU collectives — the
+    sweep JSON stays parseable either way.
+    """
+    import json as _json
+    import socket
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    nprocs = int(os.environ.get("BENCH_SCALE_PROCESSES", "2"))
+    if nprocs < 2:
+        return {"skipped": "BENCH_SCALE_PROCESSES < 2"}
+    n = int(os.environ.get("BENCH_SCALE_POD_N", "2048"))
+    n_variants = int(os.environ.get("BENCH_SCALE_POD_V", "512"))
+    repeat = int(os.environ.get("BENCH_SCALE_REPEAT", 2))
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    _log(
+        f"bench: pod-sparse leg N={n} V={n_variants} "
+        f"processes={nprocs} ..."
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        script = os.path.join(tmp, "pod_worker.py")
+        with open(script, "w") as f:
+            f.write(_POD_SPARSE_BENCH_WORKER)
+        out = os.path.join(tmp, "pod.json")
+        env = {
+            **os.environ,
+            "PYTHONPATH": os.path.dirname(os.path.abspath(__file__)),
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": str(nprocs),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        }
+        procs = [
+            subprocess.Popen(
+                [
+                    _sys.executable,
+                    script,
+                    out,
+                    str(n),
+                    os.environ.get("BENCH_SCALE_CARRIERS", str(carriers)),
+                    str(n_variants),
+                    str(block_v),
+                    str(repeat),
+                ],
+                env={**env, "JAX_PROCESS_ID": str(i)},
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+            for i in range(nprocs)
+        ]
+        logs = []
+        try:
+            for p in procs:
+                logs.append(p.communicate(timeout=600)[0].decode())
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            return {"error": "pod-sparse leg timed out", "processes": nprocs}
+        if any(p.returncode != 0 for p in procs):
+            tails = [log[-400:] for log in logs]
+            return {
+                "error": "pod-sparse worker failed",
+                "processes": nprocs,
+                "tails": tails,
+            }
+        with open(out) as f:
+            rec = _json.load(f)
+    rec["processes"] = nprocs
+    rec["nnz_per_sec"] = round(rec["nnz"] / rec["seconds"], 2)
+    rec["seconds"] = round(rec["seconds"], 4)
+    rec["path"] = (
+        "parallel.sharded.sparse_sharded_gramian_blockwise "
+        "(_synced_carrier_stream pod protocol)"
+    )
+    _log(
+        f"bench: pod-sparse N={n} processes={nprocs} "
+        f"{rec['seconds']:.3f}s ({rec['nnz_per_sec']:.0f} nnz/s)"
+    )
+    return rec
 
 
 def cold_start_bench():

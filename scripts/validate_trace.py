@@ -68,6 +68,8 @@ _JOB_SPANS = {
 _SPARSE_SPANS = {
     "gramian.sparse.accumulate",  # one whole window-stream accumulation
     "gramian.sparse.window",      # one CSR window (route=scatter|dense)
+    "gramian.sparse.allgather",   # one pod-sparse sync step (header +
+                                  # carrier allgather across processes)
 }
 
 # Prometheus exposition line shapes (text format 0.0.4).
@@ -186,6 +188,8 @@ _LABELED_COUNTERS = {
     "serving_jobs_total": "outcome",      # done/failed/cached/deduped
     "serving_shed_total": "reason",       # queue_full/quota
     "sparse_gramian_windows_total": "route",  # scatter/dense per window
+    "sparse_pod_sync_total": "outcome",   # synced/drained/producer-error/
+                                          # route-divergence/dtype-divergence
 }
 
 

@@ -132,20 +132,6 @@ class VariantsPcaDriver:
                 "non---precise run (use --pca-mode auto to fall back "
                 "automatically)"
             )
-        if (
-            conf.pca_mode == "sparse"
-            and mesh is not None
-            and len({d.process_index for d in mesh.devices.flat}) > 1
-        ):
-            # The sparse tile scatter is single-controller today
-            # (parallel/sharded.sparse_sharded_gramian_blockwise); fail
-            # before ingest with the same routing advice the kernel
-            # gives.
-            raise ValueError(
-                "--pca-mode sparse serves host-local meshes only (any "
-                "device count); on a process-spanning mesh use the "
-                "packed dense pod path (--pca-mode auto/stream)"
-            )
         self.conf = conf
         self.source = source
         self.mesh = mesh
@@ -579,11 +565,11 @@ class VariantsPcaDriver:
     # -- stage 4: the Gramian ------------------------------------------------
 
     def _mesh_spans_processes(self) -> bool:
+        from spark_examples_tpu.parallel.mesh import mesh_spans_processes
+
         if self.mesh is None:
             return False
-        return (
-            len({d.process_index for d in self.mesh.devices.flat}) > 1
-        )
+        return mesh_spans_processes(self.mesh)
 
     def _sample_sharded(self) -> bool:
         """Shard the N×N Gramian over the mesh instead of replicating it.
@@ -726,12 +712,15 @@ class VariantsPcaDriver:
         """Route the Gramian through the sparse-aware engine?
 
         ``--pca-mode sparse`` forces it; ``auto`` selects it for the
-        biobank shape — a sample-sharded host-local mesh (G tiled, no
-        N×N on any device) on an uncheckpointed single-process run.
-        Everything else keeps the dense MXU tiers (which beat the
-        scatter at common-variant density — the per-window density gate
-        still routes dense-ish windows onto the MXU *inside* the sparse
-        engine either way).
+        biobank shape — a sample-sharded mesh (G tiled, no N×N on any
+        device) on an uncheckpointed run, whether the mesh is
+        host-local (single-process) or process-spanning (the pod
+        carrier-allgather protocol). Everything else keeps the dense
+        MXU tiers (which beat the scatter at common-variant density —
+        the per-window density gate still routes dense-ish windows onto
+        the MXU *inside* the sparse engine either way); in particular a
+        host-local mesh on a multi-process DP run stays dense (each
+        host would tile the FULL G rather than a pod share).
         """
         mode = self.conf.pca_mode
         if mode == "sparse":
@@ -740,8 +729,10 @@ class VariantsPcaDriver:
             return False
         return (
             self.mesh is not None
-            and not self._mesh_spans_processes()
-            and jax.process_count() == 1
+            and (
+                self._mesh_spans_processes()
+                or jax.process_count() == 1
+            )
             and not self.conf.checkpoint_dir
             and self._sample_sharded()
         )
@@ -749,14 +740,15 @@ class VariantsPcaDriver:
     def _sparse_host_g_bytes(self) -> int:
         """Per-host bytes the sparse accumulator's G occupies — the
         streaming-sparse footprint bound: the f32 accumulator tiles this
-        host's devices hold (``(N/rows)·(N/cols)`` each on a mesh, the
-        full N² when meshless/replicated), with only a window-sized
-        transient on top (NOTES.md verdict #7's 16·N² host peak — int64
-        host G + f32 copy + jax buffer — is gone: the sparse engine
-        never accumulates on the host)."""
+        host's ADDRESSABLE devices hold (``(N/rows)·(N/cols)`` each on a
+        mesh — a process-spanning mesh counts only this host's share of
+        the pod grid; the full N² when meshless/replicated), with only a
+        window-sized transient on top (NOTES.md verdict #7's 16·N² host
+        peak — int64 host G + f32 copy + jax buffer — is gone: the
+        sparse engine never accumulates on the host)."""
         n = self.index.size
         itemsize = 4  # f32 accumulator, exact below 2^24 counts
-        if self.mesh is not None and not self._mesh_spans_processes():
+        if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
             from spark_examples_tpu.arrays.blocks import (
@@ -787,11 +779,20 @@ class VariantsPcaDriver:
     def _windows_to_gramian(self, windows):
         """CSR carrier windows → finished G via the sparse-aware engine
         (the ONE accumulation recipe both ``--pca-mode sparse`` ingest
-        and the stream alternate share): tile-sharded scatter on a
-        host-local mesh, single-device accumulation otherwise, with the
-        per-window density gate routing dense windows onto the MXU
-        inside either engine. Meshless multi-process runs merge per-host
-        partials over DCN exactly like the dense tiers."""
+        and the stream alternate share): tile-sharded scatter on any
+        mesh — host-local, or process-spanning through the per-step
+        carrier-allgather protocol (each process feeds its manifest
+        slice; the result is already the global G, no merge) — and
+        single-device accumulation when meshless, with the per-window
+        density gate routing dense windows onto the MXU inside either
+        engine. Multi-process runs whose G is NOT process-spanning
+        (meshless, or a forced-sparse HOST-LOCAL mesh where each host
+        tiled only its manifest slice over its own devices) merge
+        per-host partials over DCN exactly like the dense tiers.
+        Per-shard retry seams
+        live in the window PRODUCERS (upstream of any collective, the
+        ``_synced_block_stream`` rule), so a retried-then-failed shard
+        raises through the synced stream on every process together."""
 
         def cancellable():
             from spark_examples_tpu.utils import softcancel
@@ -801,18 +802,34 @@ class VariantsPcaDriver:
                 yield window
 
         with self._watchdog().armed("sparse ingest+gramian"):
-            if self.mesh is not None and not self._mesh_spans_processes():
+            if self.mesh is not None:
                 from spark_examples_tpu.parallel.sharded import (
                     sparse_sharded_gramian_blockwise,
                 )
 
-                return sparse_sharded_gramian_blockwise(
+                g = sparse_sharded_gramian_blockwise(
                     cancellable(),
                     self.index.size,
                     self.mesh,
                     density_threshold=self.conf.sparse_density_threshold,
                     block_variants=self.conf.block_variants,
                 )
+                if (
+                    not self._mesh_spans_processes()
+                    and jax.process_count() > 1
+                ):
+                    # Forced sparse on a HOST-LOCAL mesh in a
+                    # multi-controller run: every step fed only this
+                    # host's slice with zero collectives, so g is a
+                    # per-host partial (the process-SPANNING mesh is
+                    # already the global sum and allreduce_gramian
+                    # refuses it).
+                    from spark_examples_tpu.parallel.distributed import (
+                        allreduce_gramian,
+                    )
+
+                    g = allreduce_gramian(g)
+                return g
             from spark_examples_tpu.ops.sparse import (
                 sparse_gramian_blockwise,
             )

@@ -126,6 +126,7 @@ def padded_carrier_matrix(
     lens: np.ndarray,
     sentinel: int,
     n_rows: Optional[int] = None,
+    k_bucket: Optional[int] = None,
 ) -> np.ndarray:
     """One CSR window → a ``(n_rows, k_bucket)`` int32 carrier matrix.
 
@@ -133,8 +134,13 @@ def padded_carrier_matrix(
     ``sentinel`` (any index ≥ the scatter target's row count — padded
     pairs are OOB and dropped by the kernel). ``n_rows`` pads the
     variant axis (tail windows, scan-chunk alignment); padded rows are
-    all-sentinel and inert. Pure vectorized numpy — this is host work on
-    the ingest path, C-speed like the densify scatter it replaces.
+    all-sentinel and inert. ``k_bucket`` overrides the locally-derived
+    power-of-two carrier bucket — the pod-sparse protocol passes the
+    bucket of the GLOBAL max width so every process pads to one agreed
+    geometry and the collective scatter executable caches per geometry
+    across hosts, never per host. Pure vectorized numpy — this is host
+    work on the ingest path, C-speed like the densify scatter it
+    replaces.
     """
     lens = np.asarray(lens, dtype=np.int64)
     window_idx = np.asarray(window_idx, dtype=np.int64)
@@ -143,7 +149,13 @@ def padded_carrier_matrix(
         raise ValueError(
             f"n_rows {rows} < window variant count {lens.size}"
         )
-    k_bucket = _carrier_bucket(int(lens.max()) if lens.size else 0)
+    k_local = int(lens.max()) if lens.size else 0
+    if k_bucket is None:
+        k_bucket = _carrier_bucket(k_local)
+    elif k_bucket < k_local:
+        raise ValueError(
+            f"k_bucket {k_bucket} < window max carrier count {k_local}"
+        )
     mat = np.full((rows, k_bucket), sentinel, dtype=np.int32)
     if window_idx.size:
         row_of = np.repeat(np.arange(lens.size, dtype=np.int64), lens)
@@ -233,6 +245,21 @@ def _note_window(route: str, nnz: int) -> None:
         "sparse_gramian_nnz_total",
         "Genotype carriers (nonzeros) accumulated by the sparse engine",
     ).inc(nnz)
+
+
+def _note_pod_sync(outcome: str) -> None:
+    """Per-step pod-sparse sync telemetry (the carrier-allgather
+    protocol in ``parallel/sharded._synced_carrier_stream``): one
+    registration site, outcome ∈ {synced, drained, producer-error,
+    route-divergence, dtype-divergence} — the label set
+    ``validate_trace._LABELED_COUNTERS`` enforces (GL003)."""
+    from spark_examples_tpu import obs
+
+    obs.get_registry().counter(
+        "sparse_pod_sync_total",
+        "Pod-sparse per-window sync steps (header + carrier allgather) "
+        "by outcome",
+    ).labels(outcome=outcome).inc()
 
 
 def sparse_gramian_blockwise(

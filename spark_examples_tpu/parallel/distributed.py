@@ -28,6 +28,26 @@ __all__ = [
 ]
 
 
+def _enable_cpu_collectives() -> None:
+    """Select a cross-process CPU collectives implementation (gloo).
+
+    A multi-process CPU mesh (the pod-sim test/bench/CI shape, and any
+    DCN-only deployment) needs a collectives backend compiled into the
+    CPU client; without one every cross-process program dies with
+    "Multiprocess computations aren't implemented on the CPU backend".
+    Must run BEFORE the backend client is created, which is why it sits
+    inside :func:`initialize_from_env` next to the distributed init.
+    Harmless for TPU pods (it only configures the host CPU client) and
+    a silent no-op on jax builds without the knob.
+    """
+    try:
+        from jax._src import xla_bridge  # noqa: F401  (defines the flag)
+
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover — jax spelling drift
+        pass
+
+
 def initialize_from_env() -> bool:
     """Initialize jax.distributed when a cluster env is present.
 
@@ -38,6 +58,7 @@ def initialize_from_env() -> bool:
     was initialized.
     """
     if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        _enable_cpu_collectives()
         jax.distributed.initialize(
             coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
             num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
@@ -45,6 +66,7 @@ def initialize_from_env() -> bool:
         )
         return True
     if os.environ.get("SPARK_EXAMPLES_TPU_MULTIHOST") == "1":
+        _enable_cpu_collectives()
         jax.distributed.initialize()
         return True
     return False
@@ -79,16 +101,18 @@ def allreduce_gramian(g_local, chunk_bytes: int = 64 << 20):
 
     if not getattr(g_local, "is_fully_addressable", True):
         # In this framework a process-spanning array can only come from the
-        # global-mesh accumulators (gramian_blockwise_global / the
-        # sample-sharded pod path), whose every block step was a collective
-        # — it already holds the global sum and must not be "merged" again.
-        # Fail loudly rather than guess: the pod driver path never calls
-        # this function (pca.get_similarity_matrix gates on the mesh).
+        # global-mesh accumulators (gramian_blockwise_global, the
+        # sample-sharded pod path, or the pod-sparse carrier-allgather
+        # accumulator), whose every step was a collective — it already
+        # holds the global sum and must not be "merged" again. Fail
+        # loudly rather than guess: the pod driver paths never call
+        # this function (pca gates on the mesh).
         raise ValueError(
             "allreduce_gramian merges HOST-LOCAL partial Gramians; this "
             "array is sharded across processes, which the global-mesh "
-            "accumulators produce already globally summed — use their "
-            "result directly instead of re-reducing it"
+            "accumulators (packed dense AND pod-sparse) produce already "
+            "globally summed — use their result directly instead of "
+            "re-reducing it"
         )
     arr = jnp.asarray(g_local)
     n = arr.shape[0]

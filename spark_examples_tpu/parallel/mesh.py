@@ -15,10 +15,22 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "DATA_AXIS", "MODEL_AXIS"]
+__all__ = ["make_mesh", "mesh_spans_processes", "DATA_AXIS", "MODEL_AXIS"]
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """Does this mesh cross a process boundary (the pod regime)?
+
+    The ONE topology predicate the accumulator routing keys on: a
+    process-spanning mesh makes every accumulation step a collective
+    (the per-step synced streams — ``_synced_block_stream`` for packed
+    dense blocks, ``_synced_carrier_stream`` for sparse carrier
+    windows), while a host-local mesh feeds devices independently.
+    """
+    return len({d.process_index for d in mesh.devices.flat}) > 1
 
 
 def make_mesh(

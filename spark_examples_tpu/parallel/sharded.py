@@ -118,7 +118,9 @@ def _axis_product(mesh: Mesh, spec: P) -> int:
 
 
 def _mesh_spans_processes(mesh: Mesh) -> bool:
-    return len({d.process_index for d in mesh.devices.flat}) > 1
+    from spark_examples_tpu.parallel.mesh import mesh_spans_processes
+
+    return mesh_spans_processes(mesh)
 
 
 # dtype.num ↔ dtype for the cross-process dtype agreement (allgather moves
@@ -686,6 +688,267 @@ def _trim_square(a, n: int):
     return a[:n, :n]
 
 
+# Route codes on the pod-sparse header wire (field 0 doubles as the
+# liveness code): −2 producer exception, −1 stream exhausted, else the
+# window's density-route decision.
+_ROUTE_CODES = {"scatter": 0, "dense": 1}
+_ROUTE_OF_CODE = {v: k for k, v in _ROUTE_CODES.items()}
+
+
+def _synced_carrier_stream(
+    windows,
+    n_samples: int,
+    n_padded: int,
+    mesh: Mesh,
+    density_threshold: float,
+    dense_width: int,
+    v_div: int,
+    x_sharding,
+    idx_sharding,
+):
+    """Per-step header/carrier-allgathered global windows from
+    per-process CSR streams — the sparse twin of
+    :func:`_synced_block_stream` (ROADMAP item 2's pod half).
+
+    Every sparse accumulation step on a process-spanning mesh is a
+    collective (the tile scatter is one ``shard_map`` program over the
+    whole mesh; the dense fallback one GSPMD matmul), so per window
+    every process FIRST allgathers a tiny host header —
+    ``[route/liveness code, k_max, variant rows, payload dtype.num,
+    nnz]`` — and only then enters the payload collective:
+
+    - a process whose stream is exhausted posts −1 and keeps feeding
+      inert payloads (all-sentinel carrier rows, or zero packed
+      columns on dense steps) until every stream drains — zero
+      contributions are inert in the Gramian, so stragglers never
+      strand peers;
+    - a producer exception posts −2 and every process raises together,
+      the failing one chaining its original exception (same failure-
+      sync discipline as :func:`_synced_block_stream`: a one-sided
+      raise would leave peers blocked in the collective forever). The
+      per-shard retry seams run INSIDE the producer, upstream of this
+      sync, so a retried-then-failed shard surfaces here, never
+      mid-collective; post-sync LOCAL payload construction (densify/
+      pack/carrier padding, whose geometry needs the gathered header)
+      is covered by a second 1-int confirm allgather before any
+      payload collective, so a host-side failure there also raises
+      everywhere together;
+    - the density route is a per-window GLOBAL decision (both routes
+      are collective programs — half the pod cannot scatter while the
+      other half matmuls): the header carries each process's local
+      :func:`spark_examples_tpu.ops.sparse.window_route` decision and a
+      divergent step raises on every process together (pin
+      ``--sparse-density-threshold`` to 0 or large to force one route
+      on heterogeneous cohorts);
+    - carrier widths are NOT required to agree — ragged windows are the
+      norm — instead every process pads to the power-of-two bucket of
+      the GLOBAL max width (and to the global max variant-row count),
+      so the collective scatter executable caches per geometry across
+      hosts.
+
+    Scatter steps then allgather the padded ``(rows, k_bucket)`` int32
+    carrier matrices themselves (~d·N·V_blk integers — tiny next to
+    the dense packed panels the pod dense path moves) and every device
+    re-bases the concatenated global matrix into its tile frame for
+    the existing OOB-drop scatter; dense steps ride the existing
+    packed pod collective (process-local panel columns of a global
+    block, exactly :func:`sharded_gramian_blockwise_global`'s layout).
+
+    Yields ``(route, global_payload, local_nnz, local_variants)``.
+    """
+    from jax.experimental import multihost_utils
+
+    from spark_examples_tpu import obs
+    from spark_examples_tpu.arrays.blocks import (
+        _check_indices,
+        _densify_window,
+        round_up_multiple,
+    )
+    from spark_examples_tpu.ops.gramian import pack_indicator_block
+    from spark_examples_tpu.ops.sparse import (
+        _carrier_bucket,
+        _note_pod_sync,
+        _pad_rows_for_scan,
+        padded_carrier_matrix,
+        window_route,
+    )
+
+    world = jax.process_count()
+    it = iter(windows)
+    step = 0
+    while True:
+        exc = None
+        window_idx = lens = None
+        code, k_max, rows, num, nnz = -1, -1, -1, -1, 0
+        try:
+            item = next(it, None)
+            if item is not None:
+                window_idx, lens = item
+                window_idx = np.asarray(window_idx, dtype=np.int64)
+                lens = np.asarray(lens, dtype=np.int64)
+                _check_indices(window_idx, n_samples)
+                route = window_route(lens, n_samples, density_threshold)
+                code = _ROUTE_CODES[route]
+                k_max = int(lens.max()) if lens.size else 0
+                rows = int(lens.size)
+                nnz = int(lens.sum())
+                # The PAYLOAD dtype rides the wire: int32 carrier
+                # matrices on scatter steps, packed uint8 panels on
+                # dense ones — agreed from identical gathered data so
+                # a divergence raises everywhere, like the dense pod
+                # stream's per-step dtype check.
+                num = np.dtype(
+                    np.int32 if route == "scatter" else np.uint8
+                ).num
+        except Exception as e:  # noqa: BLE001 — synced below, see docstring
+            exc, code = e, -2
+        with obs.span(
+            "gramian.sparse.allgather", step=step, processes=world
+        ):
+            peer_info = np.asarray(
+                multihost_utils.process_allgather(
+                    np.array([code, k_max, rows, num, nnz], np.int64)
+                )
+            ).reshape(-1, 5)
+            failed = [
+                i for i, row in enumerate(peer_info) if int(row[0]) == -2
+            ]
+            if failed:
+                _note_pod_sync("producer-error")
+                # exc is None on healthy peers — `from None` is a no-op
+                # there.
+                raise RuntimeError(
+                    "carrier stream failed on process(es) "
+                    f"{failed}; raising on every process together (a "
+                    "one-sided raise would strand peers in the next "
+                    "collective)"
+                ) from exc
+            live = peer_info[peer_info[:, 0] >= 0]
+            if live.size == 0:
+                _note_pod_sync("drained")
+                return
+            routes = sorted({int(c) for c in live[:, 0]})
+            if len(routes) > 1:
+                _note_pod_sync("route-divergence")
+                per_proc = {
+                    i: _ROUTE_OF_CODE[int(row[0])]
+                    for i, row in enumerate(peer_info)
+                    if int(row[0]) >= 0
+                }
+                raise ValueError(
+                    "sparse pod streams disagree on the density route "
+                    f"for the same step: {per_proc}; the route is a "
+                    "per-window GLOBAL decision (both routes are "
+                    "collective programs) — pin "
+                    "--sparse-density-threshold to one side for "
+                    "heterogeneous cohorts"
+                )
+            nums = sorted({int(n) for n in live[:, 3]})
+            if len(nums) > 1:
+                # The dtype is DERIVED from the agreed route today, so
+                # this can only fire on a version-skewed pod (hosts
+                # running different code deriving different payload
+                # dtypes for the same route) — the cross-version guard,
+                # not a runtime data check.
+                _note_pod_sync("dtype-divergence")
+                raise ValueError(
+                    "sparse pod payload dtypes diverged in the same "
+                    f"step: {[_dtype_name(n) for n in nums]}; every "
+                    "host must stream one payload dtype (the dtype "
+                    "derives from the agreed route — divergence means "
+                    "a version-skewed pod)"
+                )
+            route = _ROUTE_OF_CODE[routes[0]]
+            g_rows = _pad_rows_for_scan(int(live[:, 2].max()))
+            # Local payload construction is host numpy work (carrier
+            # padding, densify/pack) that can fail one-sided — e.g.
+            # MemoryError on the densify at biobank widths — AFTER the
+            # header sync has committed every peer to this step's
+            # collectives, so it runs under its own try and a 1-int
+            # confirm allgather agrees success before any payload
+            # collective: the same all-raise-together discipline, one
+            # tiny extra host sync per window.
+            payload_exc = None
+            local = None
+            try:
+                if route == "scatter":
+                    bucket = _carrier_bucket(int(live[:, 1].max()))
+                    if window_idx is None:
+                        # Exhausted (or empty) stream: all-sentinel
+                        # rows are OOB everywhere — inert by
+                        # construction.
+                        local = np.full(
+                            (g_rows, bucket), n_padded, dtype=np.int32
+                        )
+                    else:
+                        local = padded_carrier_matrix(
+                            window_idx,
+                            lens,
+                            sentinel=n_padded,
+                            n_rows=g_rows,
+                            k_bucket=bucket,
+                        )
+                else:
+                    g_dense = max(dense_width, int(live[:, 2].max()))
+                    if window_idx is None:
+                        xb = np.zeros(
+                            (n_samples, g_dense), dtype=np.int8
+                        )
+                    else:
+                        xb = _densify_window(
+                            window_idx, lens, n_samples, g_dense
+                        )
+                    if n_padded != n_samples:
+                        xb = np.pad(
+                            xb, ((0, n_padded - n_samples), (0, 0))
+                        )
+                    xp = pack_indicator_block(xb)
+                    cols = round_up_multiple(xp.shape[1], v_div)
+                    if cols != xp.shape[1]:
+                        # Zero bytes unpack to inert zero columns;
+                        # every process derives the same width from the
+                        # same gathered header, so the global shape
+                        # agrees.
+                        xp = np.pad(
+                            xp, ((0, 0), (0, cols - xp.shape[1]))
+                        )
+                    local = xp
+            except Exception as e:  # noqa: BLE001 — synced just below
+                payload_exc = e
+            confirm = np.asarray(
+                multihost_utils.process_allgather(
+                    np.array(
+                        [-2 if payload_exc is not None else 0], np.int64
+                    )
+                )
+            ).reshape(-1)
+            bad = [i for i, v in enumerate(confirm) if int(v) == -2]
+            if bad:
+                _note_pod_sync("producer-error")
+                raise RuntimeError(
+                    "carrier payload construction failed on "
+                    f"process(es) {bad}; raising on every process "
+                    "together (a one-sided raise would strand peers "
+                    "in the payload collective)"
+                ) from payload_exc
+            if route == "scatter":
+                gathered = np.asarray(
+                    multihost_utils.process_allgather(local)
+                ).reshape(-1, local.shape[1])
+                payload = jax.make_array_from_callback(
+                    gathered.shape,
+                    idx_sharding,
+                    lambda sl: gathered[sl],
+                )
+            else:
+                payload = jax.make_array_from_process_local_data(
+                    x_sharding, local
+                )
+            _note_pod_sync("synced")
+        yield route, payload, nnz, max(rows, 0)
+        step += 1
+
+
 def sparse_sharded_gramian_blockwise(
     windows,
     n_samples: int,
@@ -718,16 +981,26 @@ def sparse_sharded_gramian_blockwise(
 
     Both routes add exact integer counts, so the result is bit-identical
     to the dense reference at any mesh shape and any window order
-    (pinned by tests). Ingest is restricted to this process's
-    sample-range bounds first (:func:`addressable_sample_bounds`) —
-    the per-host sample-range contract; on a single-controller mesh the
-    bounds are the full range and the restriction is a no-op.
+    (pinned by tests). On a single-controller mesh ingest is restricted
+    to this process's sample-range bounds first
+    (:func:`addressable_sample_bounds`) — the per-host sample-range
+    contract; there the bounds are the full range and the restriction
+    is a no-op.
 
-    Process-spanning meshes are not served yet: the carrier windows
-    would need the per-step width/liveness sync plus a cross-host
-    carrier allgather (cheap — carriers are sparse — but a distinct
-    protocol); use the packed dense pod path
-    (:func:`sharded_gramian_blockwise_global`) there today.
+    PROCESS-SPANNING meshes run the per-step carrier-allgather protocol
+    (:func:`_synced_carrier_stream`, the sparse twin of
+    :func:`_synced_block_stream`): each process feeds its own variant
+    windows; per window a header allgather agrees liveness, the global
+    carrier width bucket, and the density route (divergence raises on
+    every process together — never a one-sided deadlock), then the
+    padded carrier matrices allgather cross-host (~d·N·V_blk sparse
+    integers per window instead of dense packed panels) and every
+    device re-bases the concatenated global matrix into its tile frame
+    for the same OOB-drop scatter — zero new N×N anywhere. Dense-route
+    windows of a mixed stream ride the existing packed pod collective.
+    Pod ingest ships FULL sample-range windows (each host is the source
+    of its variants for every peer's tiles), so the sample-range
+    restriction applies only to single-controller meshes.
     """
     from spark_examples_tpu import obs
     from spark_examples_tpu.arrays.blocks import (
@@ -745,14 +1018,6 @@ def sparse_sharded_gramian_blockwise(
         window_route,
     )
 
-    if _mesh_spans_processes(mesh):
-        raise NotImplementedError(
-            "sparse sharded Gramian accumulation is single-controller "
-            "today (host-local meshes, any device count); a "
-            "process-spanning mesh needs the per-step carrier allgather "
-            "protocol — use the packed dense pod path "
-            "(sharded_gramian_blockwise_global) on pods"
-        )
     if density_threshold is None:
         density_threshold = DEFAULT_SPARSE_DENSITY_THRESHOLD
     d_axis, m_axis = _mesh_axes(mesh)
@@ -764,7 +1029,7 @@ def sparse_sharded_gramian_blockwise(
     grid_cols = mesh.shape[m_axis] if m_axis is not None else 1
     tile_rows = n_padded // grid_rows
     tile_cols = n_padded // grid_cols
-    lo, hi = addressable_sample_bounds(mesh, g_sharding, n_padded)
+    spans = _mesh_spans_processes(mesh)
     compute_dtype = resolve_gramian_compute_dtype(
         jnp.int8, accum_dtype, compute_dtype
     )
@@ -779,48 +1044,84 @@ def sparse_sharded_gramian_blockwise(
         np.dtype(accum_dtype).name,
         np.dtype(compute_dtype).name,
     )
-    x_sharding = NamedSharding(mesh, P(d_axis, None))
     idx_sharding = NamedSharding(mesh, P(None, None))
     g = jax.device_put(
         jnp.zeros((n_padded, n_padded), dtype=accum_dtype), g_sharding
     )
     with obs.span("gramian.sparse.accumulate", n=n_samples, sharded=True):
-        for window_idx, lens in windows:
-            lens = np.asarray(lens)
-            _check_indices(np.asarray(window_idx), n_samples)
-            window_idx, lens = restrict_window_to_sample_range(
-                window_idx, lens, lo, hi
+        if spans:
+            # Pod mode: every step is a collective, so windows arrive
+            # through the per-step synced carrier stream — dense pod
+            # panels use the variant-axis-over-everything layout of
+            # sharded_gramian_blockwise_global.
+            x_sharding = NamedSharding(
+                mesh, P(None, tuple(mesh.axis_names))
             )
-            route = window_route(lens, n_samples, density_threshold)
-            nnz = int(lens.sum())
-            with obs.span(
-                "gramian.sparse.window",
-                route=route,
-                nnz=nnz,
-                variants=int(lens.size),
-            ):
-                if route == "scatter":
-                    idx = padded_carrier_matrix(
-                        window_idx,
-                        lens,
-                        sentinel=n_padded,
-                        n_rows=_pad_rows_for_scan(lens.size),
-                    )
-                    g = scatter(g, jax.device_put(idx, idx_sharding))
-                else:
-                    dense_width = max(width, int(lens.size))
-                    xb = _densify_window(
-                        window_idx, lens, n_samples, dense_width
-                    )
-                    if n_padded != n_samples:
-                        xb = np.pad(
-                            xb, ((0, n_padded - n_samples), (0, 0))
+            v_div = _axis_product(mesh, P(tuple(mesh.axis_names)))
+            stream = _synced_carrier_stream(
+                windows,
+                n_samples,
+                n_padded,
+                mesh,
+                density_threshold,
+                width,
+                v_div,
+                x_sharding,
+                idx_sharding,
+            )
+            for route, payload, nnz, n_variants in stream:
+                with obs.span(
+                    "gramian.sparse.window",
+                    route=route,
+                    nnz=nnz,
+                    variants=n_variants,
+                ):
+                    if route == "scatter":
+                        g = scatter(g, payload)
+                    else:
+                        g = _accum_dense(g, payload)
+                _note_window(route, nnz)
+        else:
+            x_sharding = NamedSharding(mesh, P(d_axis, None))
+            lo, hi = addressable_sample_bounds(
+                mesh, g_sharding, n_padded
+            )
+            for window_idx, lens in windows:
+                lens = np.asarray(lens)
+                _check_indices(np.asarray(window_idx), n_samples)
+                window_idx, lens = restrict_window_to_sample_range(
+                    window_idx, lens, lo, hi
+                )
+                route = window_route(lens, n_samples, density_threshold)
+                nnz = int(lens.sum())
+                with obs.span(
+                    "gramian.sparse.window",
+                    route=route,
+                    nnz=nnz,
+                    variants=int(lens.size),
+                ):
+                    if route == "scatter":
+                        idx = padded_carrier_matrix(
+                            window_idx,
+                            lens,
+                            sentinel=n_padded,
+                            n_rows=_pad_rows_for_scan(lens.size),
                         )
-                    xp = pack_indicator_block(xb)
-                    g = _accum_dense(
-                        g, jax.device_put(xp, x_sharding)
-                    )
-            _note_window(route, nnz)
+                        g = scatter(g, jax.device_put(idx, idx_sharding))
+                    else:
+                        dense_width = max(width, int(lens.size))
+                        xb = _densify_window(
+                            window_idx, lens, n_samples, dense_width
+                        )
+                        if n_padded != n_samples:
+                            xb = np.pad(
+                                xb, ((0, n_padded - n_samples), (0, 0))
+                            )
+                        xp = pack_indicator_block(xb)
+                        g = _accum_dense(
+                            g, jax.device_put(xp, x_sharding)
+                        )
+                _note_window(route, nnz)
     if n_padded == n_samples:
         return g
     return _trim_square(g, n_samples)

@@ -482,11 +482,15 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         "dispatch finish (centering + subspace eig + row sums in one "
         "device program, one readback) on single-host unsharded runs up "
         "to --dense-eigh-limit samples, and the sparse Gramian on "
-        "sample-sharded host-local-mesh runs; 'fused' forces the fused "
-        "finish; 'stream' forces the dense-eigh/randomized route; "
-        "'sparse' forces sparse-aware Gramian accumulation straight "
-        "from CSR carrier windows (no densify/pack, O(nnz-pairs) work, "
-        "G tile-sharded over the mesh — the biobank-scale route)",
+        "sample-sharded mesh runs — host-local or process-spanning; "
+        "'fused' forces the fused finish; 'stream' forces the "
+        "dense-eigh/randomized route; 'sparse' forces sparse-aware "
+        "Gramian accumulation straight from CSR carrier windows (no "
+        "densify/pack, O(nnz-pairs) work, G tile-sharded over the mesh "
+        "— the biobank-scale route; a process-spanning mesh runs the "
+        "per-window carrier-allgather protocol: ~d*N*V sparse carrier "
+        "integers cross hosts per window instead of dense packed "
+        "panels)",
     )
     p.add_argument(
         "--sparse-density-threshold",
@@ -495,7 +499,12 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         help="Sparse-Gramian dense/sparse switch: windows with carrier "
         "density strictly below this scatter straight from CSR, at or "
         "above it they densify onto the MXU path; results are "
-        "bit-identical either way (integer-exact)",
+        "bit-identical either way (integer-exact). On a "
+        "process-spanning mesh the route is a per-window GLOBAL "
+        "decision synced by the carrier-allgather header — hosts whose "
+        "same-step windows land on opposite sides of the threshold "
+        "fail together (pin the threshold to 0 or large to force one "
+        "route on heterogeneous cohorts)",
     )
     p.add_argument(
         "--eig-tol",

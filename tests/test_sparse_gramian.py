@@ -541,6 +541,415 @@ class TestSparseCliTelemetry:
         assert "sparse_gramian_nnz_total" in prom
 
 
+class TestSchemaDrift:
+    """Satellite: both rejection directions for the pod-sparse obs
+    surface — an unknown ``gramian.sparse.*`` span fails the trace
+    gate, and a ``sparse_pod_sync_total`` sample without its outcome
+    label fails the metrics gate (the closed sets GL003 cross-checks
+    statically)."""
+
+    def test_allgather_span_is_schema_known(self, tmp_path):
+        trace = tmp_path / "t.json"
+        trace.write_text(
+            json.dumps(
+                {
+                    "traceEvents": [
+                        {
+                            "ph": "X",
+                            "name": "gramian.sparse.allgather",
+                            "pid": 1,
+                            "ts": 0,
+                            "dur": 1,
+                        }
+                    ]
+                }
+            )
+        )
+        assert validate.validate_trace(str(trace)) == []
+
+    def test_unknown_sparse_span_rejected(self, tmp_path):
+        trace = tmp_path / "t.json"
+        trace.write_text(
+            json.dumps(
+                {
+                    "traceEvents": [
+                        {
+                            "ph": "X",
+                            "name": "gramian.sparse.carrier_sync",
+                            "pid": 1,
+                            "ts": 0,
+                            "dur": 1,
+                        }
+                    ]
+                }
+            )
+        )
+        errs = validate.validate_trace(str(trace))
+        assert errs and "gramian.sparse.carrier_sync" in errs[0]
+
+    def test_pod_sync_counter_requires_outcome_label(self, tmp_path):
+        good = tmp_path / "good.prom"
+        good.write_text('sparse_pod_sync_total{outcome="synced"} 3\n')
+        assert validate.validate_metrics(str(good)) == []
+        bad = tmp_path / "bad.prom"
+        bad.write_text("sparse_pod_sync_total 3\n")
+        errs = validate.validate_metrics(str(bad))
+        assert errs and "outcome" in errs[0]
+
+
+# ---------------------------------------------------------------------------
+# Process-spanning (pod) sparse protocol: subprocess-spawned
+# jax.distributed CPU harness (2 and 4 processes). Same worker pattern
+# as tests/test_multihost.py; every scenario runs under a hard timeout
+# so a stranded-peer deadlock fails the test instead of hanging it.
+# ---------------------------------------------------------------------------
+
+import socket  # noqa: E402
+import subprocess  # noqa: E402
+import textwrap  # noqa: E402
+
+pod_skip = pytest.mark.skipif(
+    os.environ.get("SPARK_EXAMPLES_TPU_SKIP_MULTIHOST") == "1",
+    reason="multihost tests disabled",
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_pod_workers(script_path, argv, n=2, timeout=300):
+    """Spawn n coordinator-connected workers; assert every one exits 0
+    within the hard timeout (a hung collective must FAIL, never hang
+    the suite — dead peers are killed in the finally)."""
+    port = _free_port()
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ),
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "JAX_NUM_PROCESSES": str(n),
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script_path)] + [str(a) for a in argv],
+            env={**env, "JAX_PROCESS_ID": str(i)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for i in range(n)
+    ]
+    try:
+        logs = [p.communicate(timeout=timeout)[0].decode() for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, log[-3000:]
+    return logs
+
+
+_POD_SPARSE_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from spark_examples_tpu.parallel.distributed import initialize_from_env
+    assert initialize_from_env()
+    from spark_examples_tpu.arrays.blocks import csr_windows
+    from spark_examples_tpu.parallel.sharded import (
+        sparse_sharded_gramian_blockwise,
+    )
+
+    pid, world = jax.process_index(), jax.process_count()
+    mesh = Mesh(np.array(jax.devices()).reshape(world, 2), ("data", "model"))
+    rep = NamedSharding(mesh, P(None, None))
+    replicate = jax.jit(lambda a: a, out_shardings=rep)
+
+    def cohort(n, v, density, seed):
+        rng = np.random.default_rng(seed)
+        x = (rng.random((n, v)) < density).astype(np.int8)
+        cols, rows = np.nonzero(x.T)
+        lens = np.bincount(cols, minlength=v)
+        offsets = np.zeros(v + 1, np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        return x, (rows.astype(np.int64), offsets)
+
+    out = {}
+    n = 37
+    x, pair = cohort(n, 300, 0.06, seed=1)
+    windows = list(csr_windows(iter([pair]), 32))
+    mine = windows[pid::world]  # uneven per-process streams (tail)
+
+    # 1. Pod-sparse G over round-robin window slices, manifest order.
+    g = sparse_sharded_gramian_blockwise(
+        iter(mine), n, mesh, block_variants=32
+    )
+    assert not g.is_fully_addressable  # really cross-process sharded
+    out["g"] = np.asarray(replicate(g)).tolist()
+    out["tile_shapes"] = sorted(
+        str(s.data.shape) for s in g.addressable_shards
+    )
+
+    # 2. Shuffled window order (each process shuffles its own slice) —
+    # integer-exact accumulation is order-invariant.
+    rng = np.random.default_rng(3 + pid)
+    shuffled = [mine[i] for i in rng.permutation(len(mine))]
+    g2 = sparse_sharded_gramian_blockwise(
+        iter(shuffled), n, mesh, block_variants=32
+    )
+    out["g_shuffled"] = np.asarray(replicate(g2)).tolist()
+
+    # 3. Density edges: all-zero window, single-nnz row, and a mixed
+    # dense+scatter stream where SAME-STEP windows agree on the route
+    # (steps 0-1 scatter everywhere, step 2 dense everywhere: density
+    # 12/19 >= 0.5 on every process) — the pod route is a per-step
+    # global decision, and the per-route window counter pins that the
+    # dense pod payload branch REALLY ran (not just scatter twice).
+    from spark_examples_tpu import obs
+    cnt = obs.get_registry().counter(
+        "sparse_gramian_windows_total",
+        "CSR windows accumulated by the sparse-aware Gramian engine",
+    )
+    before = {r: cnt.labels(route=r).value for r in ("scatter", "dense")}
+    edge = [
+        (np.zeros(0, np.int64), np.zeros(8, np.int64)),       # all-zero
+        (np.array([4 + pid], np.int64), np.array([1], np.int64)),
+        (
+            np.arange(12, dtype=np.int64),                     # dense step
+            np.array([12], np.int64),
+        ),
+    ]
+    g3 = sparse_sharded_gramian_blockwise(
+        iter(edge), 19, mesh, density_threshold=0.5, block_variants=8
+    )
+    out["g_edges"] = np.asarray(
+        jax.jit(lambda a: a, out_shardings=rep)(g3)
+    ).tolist()
+    out["edge_routes"] = {
+        r: cnt.labels(route=r).value - before[r]
+        for r in ("scatter", "dense")
+    }
+
+    # 4. Forced sparse on a HOST-LOCAL mesh in this multi-controller
+    # run: each process tiles only ITS slice over its OWN devices with
+    # zero collectives, so the result is a per-host partial — the
+    # driver-side allreduce_gramian merge (pca._windows_to_gramian's
+    # non-spanning multi-process branch) must reproduce the global G.
+    from spark_examples_tpu.parallel.distributed import allreduce_gramian
+    local_mesh = Mesh(
+        np.array(jax.local_devices()).reshape(1, -1), ("data", "model")
+    )
+    g4 = sparse_sharded_gramian_blockwise(
+        iter(mine), n, local_mesh, block_variants=32
+    )
+    assert g4.is_fully_addressable
+    out["g_hostlocal_merged"] = np.asarray(allreduce_gramian(g4)).tolist()
+
+    if pid == 0:
+        with open(sys.argv[1], "w") as f:
+            json.dump(out, f)
+    """
+)
+
+
+_POD_CHAOS_WORKER = textwrap.dedent(
+    """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from spark_examples_tpu.parallel.distributed import initialize_from_env
+    assert initialize_from_env()
+    from spark_examples_tpu.parallel.sharded import (
+        sparse_sharded_gramian_blockwise,
+    )
+    from spark_examples_tpu import obs
+
+    pid, world = jax.process_index(), jax.process_count()
+    mesh = Mesh(np.array(jax.devices()).reshape(world, 2), ("data", "model"))
+    results = {}
+
+    def win(idx, lens):
+        return np.asarray(idx, np.int64), np.asarray(lens, np.int64)
+
+    # A. Producer exception on ONE process mid-stream must raise on
+    # EVERY process together — never a stranded peer in the collective.
+    def failing(pid):
+        yield win([1, 2], [2])
+        if pid == 0:
+            raise IOError("injected mid-stream ingest failure")
+        yield win([3], [1])
+
+    try:
+        sparse_sharded_gramian_blockwise(failing(pid), 9, mesh)
+        results["chaos"] = False
+    except RuntimeError as e:
+        ok = "carrier stream failed on process(es) [0]" in str(e)
+        if pid == 0:
+            ok = ok and isinstance(e.__cause__, IOError)
+        else:
+            ok = ok and e.__cause__ is None
+        results["chaos"] = ok
+
+    # B. Same-step route divergence (one process's window densifies,
+    # the peers' scatter) is a per-window GLOBAL decision: ValueError
+    # on every process together.
+    def divergent(pid):
+        if pid == 0:
+            yield win(np.arange(6), [6])  # density 6/9 -> dense
+        else:
+            yield win([0], [1])           # density 1/9 -> scatter
+    try:
+        sparse_sharded_gramian_blockwise(
+            divergent(pid), 9, mesh, density_threshold=0.5
+        )
+        results["divergence"] = False
+    except ValueError as e:
+        results["divergence"] = (
+            "density route" in str(e)
+            and "--sparse-density-threshold" in str(e)
+        )
+
+    # C. Payload construction failure AFTER the header sync (the
+    # densify-OOM shape): _densify_window raises on process 0 only —
+    # the payload-confirm allgather must turn it into an all-process
+    # raise instead of stranding process 1 in the payload collective.
+    from spark_examples_tpu.arrays import blocks as _blocks
+
+    real_densify = _blocks._densify_window
+
+    def _oom(*a, **k):
+        raise MemoryError("injected densify failure")
+
+    if pid == 0:
+        _blocks._densify_window = _oom
+    try:
+        sparse_sharded_gramian_blockwise(
+            iter([win(np.arange(12), [12])]),  # 12/19 >= 0.5 -> dense
+            19,
+            mesh,
+            density_threshold=0.5,
+        )
+        results["payload"] = False
+    except RuntimeError as e:
+        ok = (
+            "carrier payload construction failed on process(es) [0]"
+            in str(e)
+        )
+        if pid == 0:
+            ok = ok and isinstance(e.__cause__, MemoryError)
+        else:
+            ok = ok and e.__cause__ is None
+        results["payload"] = ok
+    finally:
+        _blocks._densify_window = real_densify
+
+    # D. The sync counter recorded every outcome on every process.
+    counter = obs.get_registry().counter(
+        "sparse_pod_sync_total",
+        "Pod-sparse per-window sync steps (header + carrier allgather) "
+        "by outcome",
+    )
+    results["outcomes"] = {
+        o: counter.labels(outcome=o).value
+        for o in ("synced", "producer-error", "route-divergence")
+    }
+    with open(sys.argv[1] + f".{pid}", "w") as f:
+        json.dump(results, f)
+    """
+)
+
+
+@pod_skip
+class TestPodSparseProtocol:
+    """The per-step carrier-allgather protocol on a REAL ≥2-process
+    ``jax.distributed`` CPU mesh: G bit-identical across
+    {single-controller sparse, pod-sparse, dense reference} × shuffled
+    window orders × density edges, and the failure-sync chaos cases."""
+
+    @pytest.mark.parametrize("nprocs", [2, 4])
+    def test_pod_sparse_bit_identical_to_dense(self, tmp_path, nprocs):
+        if nprocs > (os.cpu_count() or 1) * 4:
+            pytest.skip("not enough cores to host the pod-sim")
+        script = tmp_path / "worker.py"
+        script.write_text(_POD_SPARSE_WORKER)
+        out_file = tmp_path / "result.json"
+        _run_pod_workers(script, [out_file], n=nprocs)
+        result = json.loads(out_file.read_text())
+
+        # Dense reference + single-controller sparse over the SAME
+        # cohort the pod split round-robin (cohort_csr(seed=1) is the
+        # worker's generator, bit for bit).
+        x, pair = cohort_csr(37, 300, density=0.06, seed=1)
+        want = np.asarray(gramian(x))
+        single = np.asarray(
+            sparse_gramian_blockwise(
+                csr_windows(iter([pair]), 32), 37, block_variants=32
+            )
+        )
+        got = np.asarray(result["g"])
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(got, single)
+        np.testing.assert_array_equal(
+            np.asarray(result["g_shuffled"]), want
+        )
+
+        # Density edges: the expectation is the union of every
+        # process's edge windows (all-zero + per-process single-nnz +
+        # one identical dense window each); the route counter pins
+        # that the stream REALLY split 2 scatter + 1 dense steps.
+        want_e = np.zeros((19, 19), np.float32)
+        for p in range(nprocs):
+            want_e[4 + p, 4 + p] += 1
+        r = np.arange(12)
+        want_e[np.ix_(r, r)] += nprocs
+        np.testing.assert_array_equal(
+            np.asarray(result["g_edges"]), want_e
+        )
+        assert result["edge_routes"] == {"scatter": 2, "dense": 1}
+
+        # The host-local-mesh partial + DCN merge (the forced-sparse
+        # multi-controller driver route) reproduces the global G.
+        np.testing.assert_array_equal(
+            np.asarray(result["g_hostlocal_merged"]), want
+        )
+
+    def test_pod_failure_sync_chaos(self, tmp_path):
+        """One-sided producer failures (mid-stream AND post-header
+        payload construction) and same-step route divergence raise on
+        EVERY process together — the run completes (no hang) under the
+        harness's hard timeout."""
+        script = tmp_path / "worker.py"
+        script.write_text(_POD_CHAOS_WORKER)
+        out_file = tmp_path / "result.json"
+        _run_pod_workers(script, [out_file], n=2, timeout=240)
+        for pid in (0, 1):
+            r = json.loads((tmp_path / f"result.json.{pid}").read_text())
+            assert r["chaos"], r
+            assert r["divergence"], r
+            assert r["payload"], r
+            assert r["outcomes"]["synced"] >= 1, r
+            # One from the mid-stream producer exception, one from the
+            # post-header payload-construction failure.
+            assert r["outcomes"]["producer-error"] == 2, r
+            assert r["outcomes"]["route-divergence"] == 1, r
+
+
 @pytest.mark.slow
 def test_biobank_scale_65k_end_to_end_on_mesh():
     """ROADMAP item 2 acceptance: a synthetic N=65536 rare-variant
