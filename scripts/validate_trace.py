@@ -29,6 +29,7 @@ import sys
 from typing import Any, Dict, List
 
 __all__ = [
+    "sparse_overlap_proven",
     "validate_trace",
     "validate_metrics",
     "validate_manifest",
@@ -68,8 +69,12 @@ _JOB_SPANS = {
 _SPARSE_SPANS = {
     "gramian.sparse.accumulate",  # one whole window-stream accumulation
     "gramian.sparse.window",      # one CSR window (route=scatter|dense)
-    "gramian.sparse.allgather",   # one pod-sparse sync step (header +
-                                  # carrier allgather across processes)
+    "gramian.sparse.allgather",   # one pod-sparse exchange phase
+                                  # (header/confirm/carrier across
+                                  # processes)
+    "gramian.sparse.slot",        # one pipelined pod protocol step on
+                                  # the sync thread (the whole slot:
+                                  # gang pull + exchanges + payload)
 }
 
 # Prometheus exposition line shapes (text format 0.0.4).
@@ -89,6 +94,41 @@ _MANIFEST_REQUIRED = (
     "counters",
     "histograms",
 )
+
+
+def sparse_overlap_proven(events: List[Dict[str, Any]]) -> bool:
+    """True when some step w+1 pod-sparse exchange span begins before
+    step w's accumulation (window) span ends on a Chrome-trace event
+    list — the pipelined carrier stream's overlap PROOF. The pod-sim
+    CI leg, the bench pod leg, and the pod test worker all assert
+    through THIS predicate, so the span-schema coupling (names and the
+    ``step``/``stream`` args) lives in one place next to the closed
+    span sets it depends on. Comparisons are scoped per ``stream``:
+    step numbers restart for every accumulation stream, and comparing
+    across streams could prove "overlap" between a later stream's
+    windows and an earlier stream's exchanges.
+    """
+    window_end: Dict[Any, float] = {}
+    for ev in events:
+        if (
+            ev.get("ph") == "X"
+            and ev.get("name") == "gramian.sparse.window"
+        ):
+            args = ev.get("args", {})
+            step = args.get("step")
+            if step is not None:
+                key = (args.get("stream"), int(step))
+                window_end[key] = ev["ts"] + ev["dur"]
+    for ev in events:
+        if (
+            ev.get("ph") == "X"
+            and ev.get("name") == "gramian.sparse.allgather"
+        ):
+            args = ev.get("args", {})
+            prev = (args.get("stream"), int(args.get("step", 0)) - 1)
+            if prev in window_end and ev["ts"] < window_end[prev]:
+                return True
+    return False
 
 
 def _load_json(path: str, errors: List[str]) -> Any:
@@ -188,6 +228,7 @@ _LABELED_COUNTERS = {
     "serving_jobs_total": "outcome",      # done/failed/cached/deduped
     "serving_shed_total": "reason",       # queue_full/quota
     "sparse_gramian_windows_total": "route",  # scatter/dense per window
+    "sparse_pod_coalesced_windows_total": "mode",  # gang/solo per step
     "sparse_pod_sync_total": "outcome",   # synced/drained/producer-error/
                                           # route-divergence/dtype-divergence
 }

@@ -120,6 +120,16 @@ class VariantsPcaDriver:
                 "--sparse-density-threshold must be >= 0, got "
                 f"{conf.sparse_density_threshold}"
             )
+        if getattr(conf, "pod_pipeline_depth", 2) < 0:
+            raise ValueError(
+                "--pod-pipeline-depth must be >= 0 (0 = inline "
+                f"lockstep), got {conf.pod_pipeline_depth}"
+            )
+        if getattr(conf, "pod_coalesce_variants", 256) < 0:
+            raise ValueError(
+                "--pod-coalesce-variants must be >= 0 (0 disables "
+                f"coalescing), got {conf.pod_coalesce_variants}"
+            )
         if conf.pca_mode == "fused" and (
             conf.precise or mesh is not None or jax.process_count() > 1
         ):
@@ -813,6 +823,8 @@ class VariantsPcaDriver:
                     self.mesh,
                     density_threshold=self.conf.sparse_density_threshold,
                     block_variants=self.conf.block_variants,
+                    pipeline_depth=self.conf.pod_pipeline_depth,
+                    coalesce_variants=self.conf.pod_coalesce_variants,
                 )
                 if (
                     not self._mesh_spans_processes()
@@ -1564,14 +1576,41 @@ class VariantsPcaDriver:
                 if self.conf.eig_tol is not None
                 else {}
             )
-            coords, _, row_sums = fused_finish(
-                jnp.asarray(g), self.conf.num_pc, timer=timer, **kwargs
-            )
-            nonzero = int((np.asarray(row_sums) > 0).sum())
-            print(
-                f"Non zero rows in matrix: {nonzero} / {self.index.size}."
-            )
-            return self._emit_tuples(coords)
+            try:
+                coords, _, row_sums = fused_finish(
+                    jnp.asarray(g), self.conf.num_pc, timer=timer, **kwargs
+                )
+            except FloatingPointError as e:
+                # The CholeskyQR panel collapses (non-finite Ritz
+                # values) on numerically degenerate centered Gramians —
+                # e.g. near-duplicate cohorts from multi-dataset
+                # merges. Under AUTO selection that must not kill the
+                # run: dense eigh handles rank deficiency exactly, and
+                # N here is ≤ --dense-eigh-limit by the eligibility
+                # gate, so fall back loudly. A forced --pca-mode fused
+                # keeps the historical hard error (the user asked for
+                # exactly that program).
+                if self.conf.pca_mode == "fused":
+                    raise
+                import warnings
+
+                warnings.warn(
+                    "fused finish collapsed on a numerically "
+                    f"degenerate centered Gramian ({e}); falling back "
+                    "to the dense-eigh finish (exact on rank-deficient "
+                    "spectra)"
+                )
+                if timer is not None:
+                    timer.note(
+                        "fused finish degenerate -> dense-eigh fallback"
+                    )
+            else:
+                nonzero = int((np.asarray(row_sums) > 0).sum())
+                print(
+                    f"Non zero rows in matrix: {nonzero} / "
+                    f"{self.index.size}."
+                )
+                return self._emit_tuples(coords)
 
         addressable = getattr(g, "is_fully_addressable", True)
         # Row sums reduce on device (mesh collectives when sharded); only

@@ -48,6 +48,7 @@ __all__ = [
     "gramian_accumulate_packed",
     "gramian_blockwise",
     "mxu_cross_product",
+    "mxu_cross_product_pair",
     "pack_indicator_block",
     "resolve_gramian_compute_dtype",
     "unpack_indicator_block",
@@ -92,16 +93,26 @@ def mxu_cross_product(x, out_dtype, compute_dtype=None):
     must resolve via :func:`resolve_gramian_compute_dtype` outside the
     trace (all public entry points here and in ``parallel/sharded`` do).
     """
+    return mxu_cross_product_pair(x, x, out_dtype, compute_dtype)
+
+
+def mxu_cross_product_pair(a, b, out_dtype, compute_dtype=None):
+    """``A @ B.T`` under the Gramian exact-dtype policy — the
+    cross-tile form the pod-sparse dense step uses (each device
+    multiplies its tile's ROW slice of X against its COLUMN slice).
+    :func:`mxu_cross_product` is the ``a is b`` special case and
+    delegates here, so the integer-MXU routing and the exactness
+    argument live in exactly ONE body."""
     compute_dtype = resolve_gramian_compute_dtype(
-        x.dtype, out_dtype, compute_dtype
+        a.dtype, out_dtype, compute_dtype
     )
-    xf = x.astype(compute_dtype)
+    af, bf = a.astype(compute_dtype), b.astype(compute_dtype)
     if compute_dtype == jnp.int8:
         prod = jnp.einsum(
-            "nv,mv->nm", xf, xf, preferred_element_type=jnp.int32
+            "nv,mv->nm", af, bf, preferred_element_type=jnp.int32
         )
         return prod.astype(out_dtype)
-    return jnp.einsum("nv,mv->nm", xf, xf, preferred_element_type=out_dtype)
+    return jnp.einsum("nv,mv->nm", af, bf, preferred_element_type=out_dtype)
 
 
 @partial(jax.jit, static_argnames=("compute_dtype", "accum_dtype"))

@@ -1,0 +1,239 @@
+"""Pallas scatter-accumulate kernel for the OOB-drop tile update.
+
+The sparse engine's scatter (``ops/sparse.scatter_pairs_chunked``) is a
+chunked ``lax.scan`` over XLA scatter-adds: correct and portable, but on
+TPU every update serializes through the scatter unit while the
+accumulating G tile bounces through HBM once per chunk. This module is
+the fused alternative in the spirit of the blocked genotype-matrix
+kernels of *Fast PCA of genotype matrices in Julia* (arxiv 1808.03374):
+reformulate each variant's contribution as a rank-1 outer product of
+one-hot *count* vectors,
+
+    ΔG = Σ_v r_v · c_vᵀ,   r_v[t] = |{a : row_idx[v,a] = t}|,
+
+so a chunk of C variants becomes ONE (BR, C) × (C, TC) MXU matmul with
+the accumulating tile block held VMEM-resident across every carrier
+chunk (the grid revisits the same output block over the chunk axis —
+the tile leaves VMEM once, at the end). Out-of-bounds indices (the
+carrier pad sentinel, out-of-tile carriers) match no one-hot lane and
+drop exactly like the scatter's ``mode="drop"``; duplicate carriers
+count multiply, exactly like scatter-add duplicate semantics. Every
+update is an exact small-integer count in float32, so the result is
+**bit-identical** to the scan path (pinned by tests/test_scatter_kernel).
+
+Selection (resolved OUTSIDE any trace — the callers thread the decision
+in as a static arg):
+
+- ``SPARK_EXAMPLES_TPU_SCATTER_KERNEL=0`` — kill switch, scan always
+  (the CI kernel-fallback leg runs the whole scatter suite this way);
+- ``SPARK_EXAMPLES_TPU_SCATTER_KERNEL=interpret`` — force the Pallas
+  kernel in interpreter mode (runs on CPU; how the tests pin
+  bit-identity without a TPU);
+- unset / ``1`` — auto: the compiled kernel on Mosaic-capable backends
+  (TPU) when the tile geometry fits the VMEM budget
+  (``SPARK_EXAMPLES_TPU_SCATTER_KERNEL_VMEM`` bytes, default 8 MiB),
+  the scan path everywhere else — CPU/GPU simulations keep their exact
+  historical executable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "kernel_block_rows",
+    "resolve_scatter_path",
+    "scatter_pairs_kernel",
+]
+
+# float32 hardware tiling on TPU: (8, 128) min tile — kernel eligibility
+# requires the G tile to divide into lane-aligned blocks.
+_SUBLANE = 8
+_LANE = 128
+
+_DEFAULT_VMEM_BUDGET = 8 << 20
+
+
+def _vmem_budget() -> int:
+    raw = os.environ.get("SPARK_EXAMPLES_TPU_SCATTER_KERNEL_VMEM", "")
+    try:
+        return int(raw) if raw else _DEFAULT_VMEM_BUDGET
+    except ValueError:
+        return _DEFAULT_VMEM_BUDGET
+
+
+def _chunk_variants() -> int:
+    from spark_examples_tpu.ops.sparse import SCATTER_CHUNK_VARIANTS
+
+    return SCATTER_CHUNK_VARIANTS
+
+
+def kernel_block_rows(t_r: int, t_c: int, k: int = 0) -> Optional[int]:
+    """Largest VMEM-fitting row-block size for a (t_r, t_c) f32 tile.
+
+    The kernel holds per grid step: the g input block + output block
+    (2·BR·TC·4 B), the chunk's one-hot count transients
+    (C·(BR+TC)·4 B), and the two (C, K) int32 index blocks — NOT small
+    at biobank carrier buckets (K=16384 alone is 33.5 MB), so ``k``
+    must be charged when known (the kernel dispatch knows it at trace
+    time; the resolve-time heuristic passes 0 and the dispatch
+    re-checks with the real bucket, falling back to scan). Returns a
+    sublane-aligned divisor of ``t_r``, or ``None`` when even the
+    minimum 8-row block cannot fit — the dispatcher then uses the scan
+    path rather than compile a kernel that cannot stage.
+    """
+    c = _chunk_variants()
+    # The (C, TC) col-count transient + the two (C, K) index blocks.
+    budget = _vmem_budget() - c * t_c * 4 - 2 * c * k * 4
+    if budget <= 0:
+        return None
+    cap = budget // (2 * t_c * 4 + c * 4)  # g in+out blocks + row counts
+    cap = min(t_r, (cap // _SUBLANE) * _SUBLANE)
+    br = cap
+    while br >= _SUBLANE:
+        if t_r % br == 0:
+            return br
+        br -= _SUBLANE
+    return None
+
+
+def _kernel_eligible(tile_shape: Tuple[int, int], dtype) -> bool:
+    t_r, t_c = int(tile_shape[0]), int(tile_shape[1])
+    if np.dtype(dtype) != np.dtype(np.float32):
+        # The one-hot count formulation is argued exact for f32 (the
+        # engine's accumulator dtype); other dtypes keep the scan path.
+        return False
+    if t_r % _SUBLANE or t_c % _LANE:
+        return False
+    return kernel_block_rows(t_r, t_c) is not None
+
+
+def _mosaic_backend() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover — backend probe failure
+        return False
+
+
+def resolve_scatter_path(tile_shape: Tuple[int, int], dtype=np.float32):
+    """``"scan" | "pallas" | "interpret"`` for one tile geometry.
+
+    Resolved OUTSIDE any jit trace (same discipline as
+    ``resolve_gramian_compute_dtype``): the callers cache executables
+    per (geometry, path), so the env switch takes effect per
+    accumulation stream, never mid-trace.
+    """
+    mode = (
+        os.environ.get("SPARK_EXAMPLES_TPU_SCATTER_KERNEL", "")
+        .strip()
+        .lower()
+    )
+    if mode in ("0", "off", "scan"):
+        return "scan"
+    if not _kernel_eligible(tile_shape, dtype):
+        return "scan"
+    if mode == "interpret":
+        return "interpret"
+    if _mosaic_backend():
+        return "pallas"
+    return "scan"
+
+
+def _scatter_kernel_body(br: int, t_c: int, k: int, c: int):
+    """Kernel closure for fixed block geometry (all shapes static)."""
+
+    def kernel(row_ref, col_ref, g_ref, out_ref):
+        from jax.experimental import pallas as pl
+
+        j = pl.program_id(1)  # carrier-chunk position (innermost)
+
+        @pl.when(j == 0)
+        def _():
+            # First chunk of this row block: seed the VMEM-resident
+            # accumulator from the incoming tile block; later chunks
+            # revisit the same block and accumulate in place.
+            out_ref[:] = g_ref[:]
+
+        base = pl.program_id(0) * br
+        ri = row_ref[:]  # (C, K) int32, OOB = sentinel >= t_r
+        cj = col_ref[:]
+        row_iota = (
+            jax.lax.broadcasted_iota(jnp.int32, (c, br), 1) + base
+        )
+        col_iota = jax.lax.broadcasted_iota(jnp.int32, (c, t_c), 1)
+
+        def body(a, carry):
+            r_cnt, c_cnt = carry
+            r = jax.lax.dynamic_slice(ri, (0, a), (c, 1))
+            cc = jax.lax.dynamic_slice(cj, (0, a), (c, 1))
+            r_cnt = r_cnt + (row_iota == r).astype(jnp.float32)
+            c_cnt = c_cnt + (col_iota == cc).astype(jnp.float32)
+            return r_cnt, c_cnt
+
+        r_cnt, c_cnt = jax.lax.fori_loop(
+            0,
+            k,
+            body,
+            (
+                jnp.zeros((c, br), jnp.float32),
+                jnp.zeros((c, t_c), jnp.float32),
+            ),
+        )
+        # Σ_v r_v · c_vᵀ over the chunk: one MXU contraction — counts
+        # are exact small integers in f32, so the add is exact.
+        # precision=HIGHEST: the default matmul precision routes f32
+        # operands through bf16 multiplies on TPU, which would round
+        # duplicate-carrier counts above 256 and break the
+        # bit-identity contract exactly on the backend that
+        # auto-selects this kernel.
+        out_ref[:] += jax.lax.dot_general(
+            r_cnt,
+            c_cnt,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    return kernel
+
+
+def scatter_pairs_kernel(g, row_idx, col_idx, interpret: bool = False):
+    """``g[row_idx[v,a], col_idx[v,b]] += 1`` — the Pallas formulation.
+
+    Drop-in for :func:`spark_examples_tpu.ops.sparse.scatter_pairs_chunked`
+    (same operands, same OOB-drop and duplicate semantics, bit-identical
+    result); callers must have resolved eligibility via
+    :func:`resolve_scatter_path` first. Traceable under jit/shard_map.
+    The resolve-time budget check cannot see the carrier bucket K (it
+    varies per window); this dispatch re-checks with the REAL K and
+    falls back to the scan body — bit-identical — when the index
+    blocks push the grid step over the VMEM budget.
+    """
+    from jax.experimental import pallas as pl
+
+    t_r, t_c = g.shape
+    v_pad, k = row_idx.shape
+    c = _chunk_variants()
+    br = kernel_block_rows(t_r, t_c, k)
+    if br is None:
+        from spark_examples_tpu.ops.sparse import scatter_pairs_chunked
+
+        return scatter_pairs_chunked(g, row_idx, col_idx)
+    grid = (t_r // br, v_pad // c)
+    return pl.pallas_call(
+        _scatter_kernel_body(br, t_c, k, c),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((c, k), lambda i, j: (j, 0)),
+            pl.BlockSpec((c, k), lambda i, j: (j, 0)),
+            pl.BlockSpec((br, t_c), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, t_c), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((t_r, t_c), g.dtype),
+        interpret=interpret,
+    )(row_idx, col_idx, g)

@@ -48,8 +48,10 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = [
+    "DEFAULT_POD_COALESCE_VARIANTS",
     "DEFAULT_SPARSE_DENSITY_THRESHOLD",
     "SCATTER_CHUNK_VARIANTS",
+    "dense_panel_width",
     "padded_carrier_matrix",
     "scatter_pairs_chunked",
     "sparse_gramian_accumulate",
@@ -71,6 +73,17 @@ DEFAULT_SPARSE_DENSITY_THRESHOLD = 0.02
 # transient at chunk * k_max^2 elements (e.g. 256 * 256^2 f32 = 67 MB)
 # instead of the whole window's V_blk * k_max^2.
 SCATTER_CHUNK_VARIANTS = 256
+
+# Pod-sparse gang coalescing target (the pipelined carrier-allgather
+# protocol in parallel/sharded._synced_carrier_stream): consecutive
+# scatter-route windows merge into one protocol step until their
+# variant-row total reaches this, so tiny windows (tail windows, small
+# shards) amortize one header + one carrier exchange instead of paying
+# per-window exchange latency. Windows at the normal block width
+# (DEFAULT_BLOCK_VARIANTS) already exceed it — coalescing only engages
+# where it pays. 0/1 disables. Aligned with SCATTER_CHUNK_VARIANTS so a
+# full gang fills at least one scan/kernel chunk.
+DEFAULT_POD_COALESCE_VARIANTS = 256
 
 _MIN_CARRIER_BUCKET = 8
 
@@ -119,6 +132,27 @@ def _carrier_bucket(k: int) -> int:
     while bucket < k:
         bucket *= 2
     return bucket
+
+
+def dense_panel_width(rows: int, block_variants: int) -> int:
+    """Padded variant width for one DENSE-route window's panel.
+
+    Historically every dense window padded to the full block width so
+    the packed MXU executable shape stayed stable — but that makes a
+    512-variant window on an 8192-variant block pay 16× its MXU work in
+    inert zero columns (measured dominant in the MULTICHIP pod bench,
+    PERFORMANCE.md decision log). The power-of-two bucket (min 8, capped
+    at the block width — ``csr_windows`` never yields wider) keeps the
+    executable count O(log V) by the same argument as
+    :func:`_carrier_bucket` while tail/small windows pay only their
+    rounded size. Zero pad columns are inert, so G is bit-identical at
+    any bucketing (pinned by the existing mixed-route pins)."""
+    if rows >= block_variants:
+        # Wider-than-block windows (only reachable through direct API
+        # use — csr_windows caps at the block width) keep the exact
+        # historical max(width, rows) behavior.
+        return max(rows, 1)
+    return min(_carrier_bucket(rows), block_variants)
 
 
 def padded_carrier_matrix(
@@ -201,10 +235,20 @@ def scatter_pairs_chunked(g, row_idx, col_idx):
     return g
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _scatter_accumulate_jit(g, idx):
-    """``g[idx[v,a], idx[v,b]] += 1`` for every (v, a, b) — OOB dropped."""
-    return scatter_pairs_chunked(g, idx, idx)
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("path",))
+def _scatter_accumulate_jit(g, idx, path="scan"):
+    """``g[idx[v,a], idx[v,b]] += 1`` for every (v, a, b) — OOB dropped.
+
+    ``path`` is the pre-resolved scatter implementation
+    (:func:`spark_examples_tpu.ops.scatter_kernel.resolve_scatter_path`):
+    the chunked-scan body, or the Pallas one-hot-count kernel
+    (compiled / interpreter mode) — bit-identical either way.
+    """
+    if path == "scan":
+        return scatter_pairs_chunked(g, idx, idx)
+    from spark_examples_tpu.ops.scatter_kernel import scatter_pairs_kernel
+
+    return scatter_pairs_kernel(g, idx, idx, interpret=path == "interpret")
 
 
 def _pad_rows_for_scan(rows: int) -> int:
@@ -214,37 +258,64 @@ def _pad_rows_for_scan(rows: int) -> int:
     return round_up_multiple(max(rows, 1), SCATTER_CHUNK_VARIANTS)
 
 
-def sparse_gramian_accumulate(g, window_idx, lens):
+def sparse_gramian_accumulate(g, window_idx, lens, scatter_path=None):
     """One sparse accumulation step: scatter a CSR window into G.
 
     ``g`` is the ``(N, N)`` device accumulator (donated — updates in
     place in device memory); the window is host CSR ``(indices, lens)``.
     Returns the updated G. Bit-identical to densifying the window and
-    running ``gramian_accumulate`` (pinned by tests).
+    running ``gramian_accumulate`` (pinned by tests). ``scatter_path``
+    pre-resolves the scan-vs-Pallas-kernel choice for streams that
+    dispatch many windows (resolved per call here when ``None``).
     """
+    from spark_examples_tpu.ops.scatter_kernel import resolve_scatter_path
+
+    if scatter_path is None:
+        scatter_path = resolve_scatter_path(g.shape, g.dtype)
     idx = padded_carrier_matrix(
         window_idx,
         lens,
         sentinel=g.shape[0],
         n_rows=_pad_rows_for_scan(np.asarray(lens).size),
     )
-    return _scatter_accumulate_jit(g, idx)
+    return _scatter_accumulate_jit(g, idx, path=scatter_path)
 
 
-def _note_window(route: str, nnz: int) -> None:
+def _note_window(route: str, nnz: int, count: int = 1) -> None:
     """Per-window telemetry shared by the single-device and mesh
-    accumulators (one registration site per metric, GL003)."""
+    accumulators (one registration site per metric, GL003). ``count``
+    is the number of CSR windows this accumulation step carried — the
+    pod protocol's coalesced gangs fold several windows into one step,
+    and a pod step fed purely by inert padding (this process drained,
+    peers still live) carries zero."""
     from spark_examples_tpu import obs
 
     reg = obs.get_registry()
     reg.counter(
         "sparse_gramian_windows_total",
         "CSR windows accumulated by the sparse-aware Gramian engine",
-    ).labels(route=route).inc()
+    ).labels(route=route).inc(count)
     reg.counter(
         "sparse_gramian_nnz_total",
         "Genotype carriers (nonzeros) accumulated by the sparse engine",
     ).inc(nnz)
+
+
+def _note_pod_gang(n_windows: int) -> None:
+    """Pod-sparse coalescing telemetry: how many local CSR windows one
+    protocol step carried, labeled by whether they rode a multi-window
+    gang (``mode="gang"``) or a solo step (``mode="solo"``) — the label
+    set ``validate_trace._LABELED_COUNTERS`` enforces (GL003). One
+    registration site; inert (zero-window) steps are not noted."""
+    if n_windows <= 0:
+        return
+    from spark_examples_tpu import obs
+
+    obs.get_registry().counter(
+        "sparse_pod_coalesced_windows_total",
+        "Local CSR windows entering pod-sparse protocol steps, by "
+        "gang/solo coalescing outcome",
+    ).labels(mode="gang" if n_windows > 1 else "solo").inc(n_windows)
 
 
 def _note_pod_sync(outcome: str) -> None:
@@ -290,10 +361,17 @@ def sparse_gramian_blockwise(
         pack_indicator_block,
     )
 
+    from spark_examples_tpu.ops.scatter_kernel import resolve_scatter_path
+
     width = block_variants or DEFAULT_BLOCK_VARIANTS
     g = jnp.zeros((n_samples, n_samples), dtype=accum_dtype)
     if device is not None:
         g = jax.device_put(g, device)
+    # One scan-vs-kernel resolution per stream (outside any trace), so
+    # the whole accumulation rides one executable family.
+    scatter_path = resolve_scatter_path(
+        (n_samples, n_samples), np.dtype(accum_dtype)
+    )
     with obs.span("gramian.sparse.accumulate", n=n_samples):
         for window_idx, lens in windows:
             lens = np.asarray(lens)
@@ -307,9 +385,13 @@ def sparse_gramian_blockwise(
                 variants=int(lens.size),
             ):
                 if route == "scatter":
-                    g = sparse_gramian_accumulate(g, window_idx, lens)
+                    g = sparse_gramian_accumulate(
+                        g, window_idx, lens, scatter_path=scatter_path
+                    )
                 else:
-                    dense_width = max(width, int(lens.size))
+                    dense_width = dense_panel_width(
+                        int(lens.size), width
+                    )
                     xp = pack_indicator_block(
                         _densify_window(
                             window_idx, lens, n_samples, dense_width

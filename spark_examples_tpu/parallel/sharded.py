@@ -631,19 +631,63 @@ def _sparse_tile_kernels(
     tile_cols: int,
     accum_name: str,
     compute_name: str,
+    scatter_path: str = "scan",
+    mirror: bool = False,
 ):
-    """Compiled kernel pair (tile scatter, dense fallback) for one
-    (mesh, padded-N, dtype) geometry — cached on the hashable geometry
-    key. ``jax.jit`` caches by function identity, so building these as
-    fresh closures per accumulation call would re-trace and re-compile
-    the shard_map program on EVERY call (the bench sweep's repeats and
-    per-job driver runs would measure XLA compilation, not
-    accumulation); the lru_cache pins one executable per geometry.
+    """Compiled kernel set (tile scatter, GSPMD dense fallback, pod
+    dense tile step, symmetric-mirror finalizer) for one (mesh,
+    padded-N, dtype, scatter-path, mirror) geometry — cached on the
+    hashable geometry key. ``jax.jit`` caches by function identity, so
+    building these as fresh closures per accumulation call would
+    re-trace and re-compile the shard_map program on EVERY call (the
+    bench sweep's repeats and per-job driver runs would measure XLA
+    compilation, not accumulation); the lru_cache pins one executable
+    per geometry.
+
+    ``scatter_path`` is the pre-resolved scan-vs-Pallas choice
+    (:func:`spark_examples_tpu.ops.scatter_kernel.resolve_scatter_path`,
+    resolved OUTSIDE the trace by the accumulator entry point) — part of
+    the cache key so the env kill switch takes effect per stream.
+
+    ``mirror=True`` (square tile grids on the pod path) exploits G's
+    symmetry: an off-diagonal tile is exactly its transpose partner's
+    transpose, so each partner computes only HALF — the upper device
+    its tile's top row-slab, the lower device its right column-slab
+    (complementary under transposition, so the pair's work splits
+    evenly across the two owning processes instead of idling one) —
+    and one final ``ppermute`` swap + transpose reassembles both
+    tiles, bit-exactly (pure copies of exact integer counts). On a g×g
+    grid this removes the g(g−1)/2 redundant off-diagonal tile
+    computations the pair-space tiling otherwise duplicates across the
+    diagonal: the dense route halves its off-diagonal MXU work
+    (scatter updates are index-driven, so there the masking only keeps
+    the partition consistent). The all_gather stays unconditional on
+    every device — no collective ever sits inside a skipped branch.
     """
+    from spark_examples_tpu.ops.gramian import mxu_cross_product_pair
+    from spark_examples_tpu.ops.scatter_kernel import scatter_pairs_kernel
     from spark_examples_tpu.ops.sparse import scatter_pairs_chunked
 
     compute_dtype = jnp.dtype(compute_name)
     g_sharding = NamedSharding(mesh, P(d_axis, m_axis))
+
+    def _grid_pos():
+        d_idx = jax.lax.axis_index(d_axis)
+        m_idx = (
+            jax.lax.axis_index(m_axis)
+            if m_axis is not None
+            else jnp.int32(0)
+        )
+        return d_idx, m_idx
+
+    def _scatter_impl(g_tile, li, lj):
+        if scatter_path == "scan":
+            return scatter_pairs_chunked(g_tile, li, lj)
+        return scatter_pairs_kernel(
+            g_tile, li, lj, interpret=scatter_path == "interpret"
+        )
+
+    half = tile_rows // 2  # mirror slab split (tiles square there)
 
     def _tile_scatter(g_tile, idx):
         # Re-base global carrier indices into this device's tile frame;
@@ -651,19 +695,30 @@ def _sparse_tile_kernels(
         # and the drop-mode scatter ignores it. Tiles partition the
         # (i, j) pair space, so the union over devices is exactly one
         # +1 per co-occurring pair — the dense path's count.
-        r0 = jax.lax.axis_index(d_axis) * tile_rows
-        c0 = (
-            jax.lax.axis_index(m_axis) * tile_cols
-            if m_axis is not None
-            else 0
-        )
+        d_idx, m_idx = _grid_pos()
+        r0 = d_idx * tile_rows
+        c0 = m_idx * tile_cols
         li = jnp.where(
             (idx >= r0) & (idx < r0 + tile_rows), idx - r0, tile_rows
         )
         lj = jnp.where(
             (idx >= c0) & (idx < c0 + tile_cols), idx - c0, tile_cols
         )
-        return scatter_pairs_chunked(g_tile, li, lj)
+        if mirror:
+            # Off-diagonal slab partition: the upper partner owns its
+            # top row-slab, the lower its right column-slab; the rest
+            # is OOB here and reconstructed by the final mirror.
+            li = jnp.where(
+                jnp.logical_and(d_idx < m_idx, li >= half),
+                tile_rows,
+                li,
+            )
+            lj = jnp.where(
+                jnp.logical_and(d_idx > m_idx, lj < half),
+                tile_cols,
+                lj,
+            )
+        return _scatter_impl(g_tile, li, lj)
 
     scatter = jax.jit(
         _shard_map(
@@ -680,7 +735,129 @@ def _sparse_tile_kernels(
         xb = unpack_indicator_block(xp, 8 * xp.shape[1])
         return g + mxu_cross_product(xb, g.dtype, compute_dtype)
 
-    return scatter, _accum_dense
+    all_axes = tuple(mesh.axis_names)
+
+    def _tile_dense_pod(g_tile, xp_loc):
+        # The pod dense step as ONE explicit shard_map program: gather
+        # the bit-PACKED panel bytes over every mesh axis (8× fewer
+        # bytes over DCN than the unpacked X the GSPMD formulation
+        # moved), unpack locally on each device, slice this tile's row
+        # and column sample ranges, and accumulate the cross matmul.
+        # The GSPMD version of this step forced an involuntary full
+        # rematerialization of the (N, V, 8) unpack broadcast on the
+        # process-spanning mesh (XLA spmd_partitioner warning) — ~14×
+        # the runtime of this explicit form at the MULTICHIP bench
+        # shape, measured in PERFORMANCE.md's decision log.
+        # The all_gather runs UNCONDITIONALLY on every device (a
+        # collective inside a skipped branch would strand peers); only
+        # the local unpack + matmul shrinks under mirror.
+        xp = jax.lax.all_gather(xp_loc, all_axes, axis=1, tiled=True)
+        d_idx, m_idx = _grid_pos()
+        r0 = d_idx * tile_rows
+        c0 = m_idx * tile_cols
+
+        def _mm(row_start, n_rows, col_start, n_cols):
+            # Slice the PACKED panel's sample rows first (packing is
+            # along the variant axis, so row slicing is exact), then
+            # unpack only the two slabs — never the full (N, V) panel
+            # per device (that full-unpack transient is the same waste
+            # this program exists to remove from the GSPMD form).
+            rows = unpack_indicator_block(
+                jax.lax.dynamic_slice(
+                    xp, (row_start, 0), (n_rows, xp.shape[1])
+                ),
+                8 * xp.shape[1],
+            )
+            cols = unpack_indicator_block(
+                jax.lax.dynamic_slice(
+                    xp, (col_start, 0), (n_cols, xp.shape[1])
+                ),
+                8 * xp.shape[1],
+            )
+            return mxu_cross_product_pair(
+                rows, cols, g_tile.dtype, compute_dtype
+            )
+
+        if not mirror:
+            return g_tile + _mm(r0, tile_rows, c0, tile_cols)
+        # Slab partition (see docstring): upper partner computes only
+        # its top row-slab, lower only its right column-slab — half the
+        # MXU work each; diagonal tiles compute in full. lax.cond
+        # executes one branch, so the skipped halves cost nothing.
+        return jax.lax.cond(
+            d_idx == m_idx,
+            lambda g: g + _mm(r0, tile_rows, c0, tile_cols),
+            lambda g: jax.lax.cond(
+                d_idx < m_idx,
+                lambda gg: gg.at[:half, :].add(
+                    _mm(r0, half, c0, tile_cols)
+                ),
+                lambda gg: gg.at[:, half:].add(
+                    _mm(r0, tile_rows, c0 + half, tile_cols - half)
+                ),
+                g,
+            ),
+            g_tile,
+        )
+
+    accum_dense_pod = jax.jit(
+        _shard_map(
+            _tile_dense_pod,
+            mesh=mesh,
+            in_specs=(P(d_axis, m_axis), P(None, all_axes)),
+            out_specs=P(d_axis, m_axis),
+        ),
+        donate_argnums=(0,),
+    )
+
+    mirror_fill = None
+    if mirror:
+        grid_rows = mesh.shape[d_axis]
+        grid_cols = mesh.shape[m_axis] if m_axis is not None else 1
+        # Full involution over the tile grid: device (i, j) receives
+        # tile (j, i) and reassembles its own tile from the slab
+        # partition — the upper partner computed rows [0, half), so
+        # transpose(partner) provides the lower partner's columns
+        # [0, half), and vice versa; diagonal tiles are already whole.
+        perm = [
+            (i * grid_cols + j, j * grid_cols + i)
+            for i in range(grid_rows)
+            for j in range(grid_cols)
+        ]
+
+        def _mirror_tiles(g_tile):
+            d_idx, m_idx = _grid_pos()
+            swapped = jax.lax.ppermute(
+                g_tile, (d_axis, m_axis), perm
+            )
+            st = jnp.swapaxes(swapped, 0, 1)
+            # Upper tile: own rows [0, half) + partner's column slab
+            # transposed (= rows [half, tile)). Lower tile: partner's
+            # row slab transposed (= columns [0, half)) + own columns
+            # [half, tile). Exact copies of exact integer counts.
+            upper = jnp.concatenate(
+                [g_tile[:half, :], st[half:, :]], axis=0
+            )
+            lower = jnp.concatenate(
+                [st[:, :half], g_tile[:, half:]], axis=1
+            )
+            return jnp.where(
+                d_idx == m_idx,
+                g_tile,
+                jnp.where(d_idx < m_idx, upper, lower),
+            )
+
+        mirror_fill = jax.jit(
+            _shard_map(
+                _mirror_tiles,
+                mesh=mesh,
+                in_specs=P(d_axis, m_axis),
+                out_specs=P(d_axis, m_axis),
+            ),
+            donate_argnums=(0,),
+        )
+
+    return scatter, _accum_dense, accum_dense_pod, mirror_fill
 
 
 @partial(jax.jit, static_argnames=("n",))
@@ -705,37 +882,44 @@ def _synced_carrier_stream(
     v_div: int,
     x_sharding,
     idx_sharding,
+    pipeline_depth: int = 2,
+    coalesce_variants: int = None,
 ):
-    """Per-step header/carrier-allgathered global windows from
+    """Pipelined per-step header/carrier exchange of global windows from
     per-process CSR streams — the sparse twin of
-    :func:`_synced_block_stream` (ROADMAP item 2's pod half).
+    :func:`_synced_block_stream` (ROADMAP item 2's pod half), rebuilt as
+    a depth-D pipeline over the host-side coordination-service exchange
+    (:mod:`spark_examples_tpu.parallel.podstream`, ROADMAP item 3).
 
-    Every sparse accumulation step on a process-spanning mesh is a
-    collective (the tile scatter is one ``shard_map`` program over the
-    whole mesh; the dense fallback one GSPMD matmul), so per window
-    every process FIRST allgathers a tiny host header —
-    ``[route/liveness code, k_max, variant rows, payload dtype.num,
-    nnz]`` — and only then enters the payload collective:
+    Every sparse accumulation step on a process-spanning mesh runs one
+    collective device program (the tile scatter / the pod dense tile
+    matmul over the whole mesh), so per step every process FIRST agrees
+    a tiny host header — ``[route/liveness code, k_max, variant rows,
+    payload dtype.num, nnz, windows]`` — and only then enters the
+    payload phase. The agreement used to ride device allgathers
+    enqueued behind the previous window's scatter on each device's
+    serial stream — collective latency serialized against compute. Now
+    header, payload-confirm, and carrier exchange are pure host RPCs on
+    a sync thread: window ``w+1``'s whole protocol step (including its
+    densify/pack/carrier-padding host work) runs while window ``w``'s
+    scatter executes on device, ``pipeline_depth`` slots ahead
+    (``0`` = inline lockstep, the ablation mode). The failure-sync
+    discipline carries over slot-by-slot:
 
     - a process whose stream is exhausted posts −1 and keeps feeding
-      inert payloads (all-sentinel carrier rows, or zero packed
-      columns on dense steps) until every stream drains — zero
-      contributions are inert in the Gramian, so stragglers never
-      strand peers;
-    - a producer exception posts −2 and every process raises together,
-      the failing one chaining its original exception (same failure-
-      sync discipline as :func:`_synced_block_stream`: a one-sided
-      raise would leave peers blocked in the collective forever). The
-      per-shard retry seams run INSIDE the producer, upstream of this
-      sync, so a retried-then-failed shard surfaces here, never
-      mid-collective; post-sync LOCAL payload construction (densify/
-      pack/carrier padding, whose geometry needs the gathered header)
-      is covered by a second 1-int confirm allgather before any
-      payload collective, so a host-side failure there also raises
-      everywhere together;
-    - the density route is a per-window GLOBAL decision (both routes
-      are collective programs — half the pod cannot scatter while the
-      other half matmuls): the header carries each process's local
+      inert payloads (all-sentinel carrier rows, or zero packed columns
+      on dense steps) until every stream drains — zero contributions
+      are inert in the Gramian, so stragglers never strand peers;
+    - a producer exception posts −2 and every process raises together
+      at the SAME slot position, the failing one chaining its original
+      exception (a one-sided raise would leave peers blocked in the
+      next device collective forever). The per-shard retry seams run
+      INSIDE the producer, upstream of this sync; post-header LOCAL
+      payload construction failures are covered by the payload-confirm
+      exchange before any payload moves, for every in-flight slot;
+    - the density route is a per-step GLOBAL decision (both routes are
+      collective device programs — half the pod cannot scatter while
+      the other half matmuls): the header carries each process's local
       :func:`spark_examples_tpu.ops.sparse.window_route` decision and a
       divergent step raises on every process together (pin
       ``--sparse-density-threshold`` to 0 or large to force one route
@@ -744,20 +928,29 @@ def _synced_carrier_stream(
       norm — instead every process pads to the power-of-two bucket of
       the GLOBAL max width (and to the global max variant-row count),
       so the collective scatter executable caches per geometry across
-      hosts.
+      hosts;
+    - tiny scatter-route windows COALESCE into one gang per step
+      (consecutive local windows until their variant-row total reaches
+      ``coalesce_variants``; a dense-route window ends the gang and
+      becomes its own step), so per-step exchange latency amortizes
+      over many windows — bit-identical at any gang split (exact
+      integer accumulation, pinned by tests).
 
-    Scatter steps then allgather the padded ``(rows, k_bucket)`` int32
-    carrier matrices themselves (~d·N·V_blk integers — tiny next to
-    the dense packed panels the pod dense path moves) and every device
-    re-bases the concatenated global matrix into its tile frame for
-    the existing OOB-drop scatter; dense steps ride the existing
-    packed pod collective (process-local panel columns of a global
-    block, exactly :func:`sharded_gramian_blockwise_global`'s layout).
+    Scatter steps exchange the padded ``(rows, k_bucket)`` int32
+    carrier matrices host-side (~d·N·V_blk integers — tiny next to the
+    dense packed panels; a drained peer's inert all-sentinel block is
+    synthesized locally from its header, zero bytes moved) and every
+    device re-bases the concatenated global matrix into its tile frame
+    for the same OOB-drop scatter; dense steps carry this process's
+    packed panel columns into the pod dense tile program
+    (packed-bytes all_gather inside the shard_map — see
+    ``_sparse_tile_kernels``).
 
-    Yields ``(route, global_payload, local_nnz, local_variants)``.
+    Yields ``(route, global_payload, local_nnz, local_variants, step,
+    local_windows, stream_id)``. Device arrays are built HERE, on the consumer
+    thread — the sync thread never touches jax, so the device
+    collective launch order stays identical on every process.
     """
-    from jax.experimental import multihost_utils
-
     from spark_examples_tpu import obs
     from spark_examples_tpu.arrays.blocks import (
         _check_indices,
@@ -766,187 +959,356 @@ def _synced_carrier_stream(
     )
     from spark_examples_tpu.ops.gramian import pack_indicator_block
     from spark_examples_tpu.ops.sparse import (
+        DEFAULT_POD_COALESCE_VARIANTS,
         _carrier_bucket,
+        _note_pod_gang,
         _note_pod_sync,
         _pad_rows_for_scan,
+        dense_panel_width,
         padded_carrier_matrix,
         window_route,
     )
+    from spark_examples_tpu.parallel.podstream import (
+        PodSlot,
+        PodWindowExchange,
+        SlotPipeline,
+    )
 
+    if coalesce_variants is None:
+        coalesce_variants = DEFAULT_POD_COALESCE_VARIANTS
+    if pipeline_depth < 0:
+        raise ValueError(
+            f"--pod-pipeline-depth must be >= 0, got {pipeline_depth}"
+        )
+    # Resolved HERE, on the consumer thread: the sync thread must
+    # never touch jax (the segfault-safety basis of the host-side
+    # exchange design — see podstream's module docstring).
     world = jax.process_count()
+    pid = jax.process_index()
+    exchange = PodWindowExchange.open()
+    if exchange is None:
+        raise RuntimeError(
+            "process-spanning sparse accumulation needs the "
+            "jax.distributed coordination service for its host-side "
+            "window exchange; initialize via parallel.distributed."
+            "initialize_from_env (any multi-process jax run has it)"
+        )
+
     it = iter(windows)
-    step = 0
-    while True:
+    pushback: list = []
+
+    def _pull():
+        if pushback:
+            return pushback.pop()
+        return next(it, None)
+
+    def _gang():
+        """This step's local windows: ``[]`` when drained, ONE
+        dense-route window, or 1+ scatter-route windows coalesced until
+        the variant-row total reaches ``coalesce_variants``."""
+        first = _pull()
+        if first is None:
+            return [], None
+        idx = np.asarray(first[0], dtype=np.int64)
+        lens = np.asarray(first[1], dtype=np.int64)
+        _check_indices(idx, n_samples)
+        route = window_route(lens, n_samples, density_threshold)
+        gang = [(idx, lens)]
+        if route == "dense":
+            return gang, route
+        total = int(lens.size)
+        while total < coalesce_variants:
+            nxt = _pull()
+            if nxt is None:
+                break
+            nidx = np.asarray(nxt[0], dtype=np.int64)
+            nlens = np.asarray(nxt[1], dtype=np.int64)
+            _check_indices(nidx, n_samples)
+            if (
+                window_route(nlens, n_samples, density_threshold)
+                != "scatter"
+            ):
+                # A dense window ends the gang and becomes the NEXT
+                # step — the route stays a per-step global decision.
+                pushback.append((nidx, nlens))
+                break
+            gang.append((nidx, nlens))
+            total += int(nlens.size)
+        return gang, "scatter"
+
+    state = {"step": 0}
+
+    def _produce_step(step):
         exc = None
-        window_idx = lens = None
-        code, k_max, rows, num, nnz = -1, -1, -1, -1, 0
+        gang: list = []
+        code, k_max, rows, num, nnz, nwin = -1, -1, -1, -1, 0, 0
         try:
-            item = next(it, None)
-            if item is not None:
-                window_idx, lens = item
-                window_idx = np.asarray(window_idx, dtype=np.int64)
-                lens = np.asarray(lens, dtype=np.int64)
-                _check_indices(window_idx, n_samples)
-                route = window_route(lens, n_samples, density_threshold)
-                code = _ROUTE_CODES[route]
-                k_max = int(lens.max()) if lens.size else 0
-                rows = int(lens.size)
-                nnz = int(lens.sum())
+            gang, route_local = _gang()
+            if gang:
+                all_lens = [lens for _, lens in gang]
+                code = _ROUTE_CODES[route_local]
+                k_max = max(
+                    (int(lens.max()) if lens.size else 0)
+                    for lens in all_lens
+                )
+                rows = sum(int(lens.size) for lens in all_lens)
+                nnz = sum(int(lens.sum()) for lens in all_lens)
+                nwin = len(gang)
                 # The PAYLOAD dtype rides the wire: int32 carrier
                 # matrices on scatter steps, packed uint8 panels on
-                # dense ones — agreed from identical gathered data so
-                # a divergence raises everywhere, like the dense pod
-                # stream's per-step dtype check.
+                # dense ones — agreed from identical gathered data so a
+                # divergence raises everywhere.
                 num = np.dtype(
-                    np.int32 if route == "scatter" else np.uint8
+                    np.int32 if route_local == "scatter" else np.uint8
                 ).num
         except Exception as e:  # noqa: BLE001 — synced below, see docstring
             exc, code = e, -2
         with obs.span(
-            "gramian.sparse.allgather", step=step, processes=world
+            "gramian.sparse.allgather",
+            step=step,
+            phase="header",
+            stream=exchange.stream,
+            processes=world,
         ):
-            peer_info = np.asarray(
-                multihost_utils.process_allgather(
-                    np.array([code, k_max, rows, num, nnz], np.int64)
-                )
-            ).reshape(-1, 5)
-            failed = [
-                i for i, row in enumerate(peer_info) if int(row[0]) == -2
-            ]
-            if failed:
-                _note_pod_sync("producer-error")
-                # exc is None on healthy peers — `from None` is a no-op
-                # there.
-                raise RuntimeError(
-                    "carrier stream failed on process(es) "
-                    f"{failed}; raising on every process together (a "
-                    "one-sided raise would strand peers in the next "
-                    "collective)"
-                ) from exc
-            live = peer_info[peer_info[:, 0] >= 0]
-            if live.size == 0:
-                _note_pod_sync("drained")
-                return
-            routes = sorted({int(c) for c in live[:, 0]})
-            if len(routes) > 1:
-                _note_pod_sync("route-divergence")
-                per_proc = {
-                    i: _ROUTE_OF_CODE[int(row[0])]
-                    for i, row in enumerate(peer_info)
-                    if int(row[0]) >= 0
-                }
-                raise ValueError(
-                    "sparse pod streams disagree on the density route "
-                    f"for the same step: {per_proc}; the route is a "
-                    "per-window GLOBAL decision (both routes are "
-                    "collective programs) — pin "
-                    "--sparse-density-threshold to one side for "
-                    "heterogeneous cohorts"
-                )
-            nums = sorted({int(n) for n in live[:, 3]})
-            if len(nums) > 1:
-                # The dtype is DERIVED from the agreed route today, so
-                # this can only fire on a version-skewed pod (hosts
-                # running different code deriving different payload
-                # dtypes for the same route) — the cross-version guard,
-                # not a runtime data check.
-                _note_pod_sync("dtype-divergence")
-                raise ValueError(
-                    "sparse pod payload dtypes diverged in the same "
-                    f"step: {[_dtype_name(n) for n in nums]}; every "
-                    "host must stream one payload dtype (the dtype "
-                    "derives from the agreed route — divergence means "
-                    "a version-skewed pod)"
-                )
-            route = _ROUTE_OF_CODE[routes[0]]
-            g_rows = _pad_rows_for_scan(int(live[:, 2].max()))
-            # Local payload construction is host numpy work (carrier
-            # padding, densify/pack) that can fail one-sided — e.g.
-            # MemoryError on the densify at biobank widths — AFTER the
-            # header sync has committed every peer to this step's
-            # collectives, so it runs under its own try and a 1-int
-            # confirm allgather agrees success before any payload
-            # collective: the same all-raise-together discipline, one
-            # tiny extra host sync per window.
-            payload_exc = None
-            local = None
-            try:
-                if route == "scatter":
-                    bucket = _carrier_bucket(int(live[:, 1].max()))
-                    if window_idx is None:
-                        # Exhausted (or empty) stream: all-sentinel
-                        # rows are OOB everywhere — inert by
-                        # construction.
-                        local = np.full(
-                            (g_rows, bucket), n_padded, dtype=np.int32
-                        )
-                    else:
-                        local = padded_carrier_matrix(
-                            window_idx,
-                            lens,
-                            sentinel=n_padded,
-                            n_rows=g_rows,
-                            k_bucket=bucket,
-                        )
-                else:
-                    g_dense = max(dense_width, int(live[:, 2].max()))
-                    if window_idx is None:
-                        xb = np.zeros(
-                            (n_samples, g_dense), dtype=np.int8
-                        )
-                    else:
-                        xb = _densify_window(
-                            window_idx, lens, n_samples, g_dense
-                        )
-                    if n_padded != n_samples:
-                        xb = np.pad(
-                            xb, ((0, n_padded - n_samples), (0, 0))
-                        )
-                    xp = pack_indicator_block(xb)
-                    cols = round_up_multiple(xp.shape[1], v_div)
-                    if cols != xp.shape[1]:
-                        # Zero bytes unpack to inert zero columns;
-                        # every process derives the same width from the
-                        # same gathered header, so the global shape
-                        # agrees.
-                        xp = np.pad(
-                            xp, ((0, 0), (0, cols - xp.shape[1]))
-                        )
-                    local = xp
-            except Exception as e:  # noqa: BLE001 — synced just below
-                payload_exc = e
-            confirm = np.asarray(
-                multihost_utils.process_allgather(
-                    np.array(
-                        [-2 if payload_exc is not None else 0], np.int64
-                    )
-                )
-            ).reshape(-1)
-            bad = [i for i, v in enumerate(confirm) if int(v) == -2]
-            if bad:
-                _note_pod_sync("producer-error")
-                raise RuntimeError(
-                    "carrier payload construction failed on "
-                    f"process(es) {bad}; raising on every process "
-                    "together (a one-sided raise would strand peers "
-                    "in the payload collective)"
-                ) from payload_exc
+            exchange.post_header(
+                step,
+                np.array([code, k_max, rows, num, nnz, nwin], np.int64),
+            )
+            peer_info = exchange.gather_headers(step, 6)
+        failed = [
+            i for i, row in enumerate(peer_info) if int(row[0]) == -2
+        ]
+        if failed:
+            _note_pod_sync("producer-error")
+            # exc is None on healthy peers — `from None` is a no-op
+            # there.
+            raise RuntimeError(
+                "carrier stream failed on process(es) "
+                f"{failed}; raising on every process together (a "
+                "one-sided raise would strand peers in the next "
+                "collective)"
+            ) from exc
+        live = peer_info[peer_info[:, 0] >= 0]
+        if live.size == 0:
+            _note_pod_sync("drained")
+            exchange.close()
+            return None
+        routes = sorted({int(c) for c in live[:, 0]})
+        if len(routes) > 1:
+            _note_pod_sync("route-divergence")
+            per_proc = {
+                i: _ROUTE_OF_CODE[int(row[0])]
+                for i, row in enumerate(peer_info)
+                if int(row[0]) >= 0
+            }
+            raise ValueError(
+                "sparse pod streams disagree on the density route "
+                f"for the same step: {per_proc}; the route is a "
+                "per-window GLOBAL decision (both routes are "
+                "collective programs) — pin "
+                "--sparse-density-threshold to one side for "
+                "heterogeneous cohorts"
+            )
+        nums = sorted({int(n) for n in live[:, 3]})
+        if len(nums) > 1:
+            # The dtype is DERIVED from the agreed route today, so this
+            # can only fire on a version-skewed pod (hosts running
+            # different code deriving different payload dtypes for the
+            # same route) — the cross-version guard, not a runtime data
+            # check.
+            _note_pod_sync("dtype-divergence")
+            raise ValueError(
+                "sparse pod payload dtypes diverged in the same "
+                f"step: {[_dtype_name(n) for n in nums]}; every "
+                "host must stream one payload dtype (the dtype "
+                "derives from the agreed route — divergence means "
+                "a version-skewed pod)"
+            )
+        route = _ROUTE_OF_CODE[routes[0]]
+        g_rows = _pad_rows_for_scan(int(live[:, 2].max()))
+        # Local payload construction is host numpy work (carrier
+        # padding, densify/pack) that can fail one-sided — e.g.
+        # MemoryError on the densify at biobank widths — AFTER the
+        # header sync has committed every peer to this step, so it runs
+        # under its own try and the confirm exchange agrees success
+        # before any payload moves: the same all-raise-together
+        # discipline, per in-flight slot.
+        payload_exc = None
+        local = None
+        bucket = 0
+        try:
             if route == "scatter":
-                gathered = np.asarray(
-                    multihost_utils.process_allgather(local)
-                ).reshape(-1, local.shape[1])
+                bucket = _carrier_bucket(int(live[:, 1].max()))
+                if gang:
+                    gidx = np.concatenate(
+                        [idx for idx, _ in gang]
+                    )
+                    glens = np.concatenate(
+                        [lens for _, lens in gang]
+                    )
+                    local = padded_carrier_matrix(
+                        gidx,
+                        glens,
+                        sentinel=n_padded,
+                        n_rows=g_rows,
+                        k_bucket=bucket,
+                    )
+                # Drained stream: nothing to post — every peer
+                # synthesizes this process's inert all-sentinel block
+                # locally from its −1 header (zero bytes moved).
+            else:
+                # Power-of-two panel bucket of the GLOBAL max row
+                # count (identical gathered data on every process ⇒
+                # identical width): tail/small windows no longer pay
+                # the full block width in inert MXU columns.
+                g_dense = dense_panel_width(
+                    int(live[:, 2].max()), dense_width
+                )
+                if gang:
+                    xb = _densify_window(
+                        gang[0][0], gang[0][1], n_samples, g_dense
+                    )
+                else:
+                    xb = np.zeros((n_samples, g_dense), dtype=np.int8)
+                if n_padded != n_samples:
+                    xb = np.pad(
+                        xb, ((0, n_padded - n_samples), (0, 0))
+                    )
+                xp = pack_indicator_block(xb)
+                cols = round_up_multiple(xp.shape[1], v_div)
+                if cols != xp.shape[1]:
+                    # Zero bytes unpack to inert zero columns; every
+                    # process derives the same width from the same
+                    # gathered header, so the global shape agrees.
+                    xp = np.pad(xp, ((0, 0), (0, cols - xp.shape[1])))
+                local = xp
+        except Exception as e:  # noqa: BLE001 — synced just below
+            payload_exc = e
+        with obs.span(
+            "gramian.sparse.allgather",
+            step=step,
+            phase="confirm",
+            stream=exchange.stream,
+            processes=world,
+        ):
+            exchange.post_confirm(step, payload_exc is None)
+            confirm = exchange.gather_confirms(step)
+        bad = [i for i, v in enumerate(confirm) if int(v) == -2]
+        if bad:
+            _note_pod_sync("producer-error")
+            raise RuntimeError(
+                "carrier payload construction failed on "
+                f"process(es) {bad}; raising on every process "
+                "together (a one-sided raise would strand peers "
+                "in the payload collective)"
+            ) from payload_exc
+        gathered = None
+        if route == "scatter":
+            with obs.span(
+                "gramian.sparse.allgather",
+                step=step,
+                phase="carrier",
+                stream=exchange.stream,
+                processes=world,
+            ):
+                if local is not None:
+                    exchange.post_payload(step, local)
+                parts = []
+                for p in range(world):
+                    if p == pid:
+                        parts.append(
+                            local
+                            if local is not None
+                            else np.full(
+                                (g_rows, bucket), n_padded, np.int32
+                            )
+                        )
+                    elif int(peer_info[p, 0]) >= 0:
+                        parts.append(
+                            exchange.get_payload(
+                                step, p, (g_rows, bucket)
+                            )
+                        )
+                    else:
+                        # Drained peer: synthesize its inert
+                        # all-sentinel block locally — zero bytes
+                        # moved for a peer with nothing to say.
+                        parts.append(
+                            np.full(
+                                (g_rows, bucket), n_padded, np.int32
+                            )
+                        )
+                gathered = np.concatenate(parts, axis=0)
+        _note_pod_sync("synced")
+        _note_pod_gang(nwin)
+        return PodSlot(
+            step=step,
+            route=route,
+            gathered=gathered,
+            local=local,
+            nnz=nnz,
+            variants=max(rows, 0),
+            windows=nwin,
+        )
+
+    def _produce():
+        step = state["step"]
+        with obs.span(
+            "gramian.sparse.slot",
+            step=step,
+            depth=pipeline_depth,
+            stream=exchange.stream,
+            processes=world,
+        ):
+            slot = _produce_step(step)
+        state["step"] = step + 1
+        return slot
+
+    # Failure-path discipline around the pipeline: a SYNCHRONIZED
+    # protocol failure (raised by next() — every process raised at the
+    # same frame boundary, pipes provably clean) propagates as-is and
+    # the mesh stays reusable (the chaos suite runs failing streams
+    # back-to-back). A ONE-SIDED abandonment — this process's device
+    # staging raising, or the consumer's loop body dying (lands here
+    # as GeneratorExit at the yield) — leaves the sync thread possibly
+    # blocked mid-read with peers' frames still on the pipes, so the
+    # mesh is poisoned: a later stream must fail loudly instead of
+    # desyncing on garbage (pod recovery = fail-stop + relaunch).
+    pipe_iter = iter(SlotPipeline(_produce, pipeline_depth))
+    while True:
+        try:
+            slot = next(pipe_iter)
+        except StopIteration:
+            return
+        try:
+            if slot.route == "scatter":
+                gathered = slot.gathered
                 payload = jax.make_array_from_callback(
                     gathered.shape,
                     idx_sharding,
-                    lambda sl: gathered[sl],
+                    lambda sl, _g=gathered: _g[sl],
                 )
             else:
                 payload = jax.make_array_from_process_local_data(
-                    x_sharding, local
+                    x_sharding, slot.local
                 )
-            _note_pod_sync("synced")
-        yield route, payload, nnz, max(rows, 0)
-        step += 1
+            item = (
+                slot.route,
+                payload,
+                slot.nnz,
+                slot.variants,
+                slot.step,
+                slot.windows,
+                exchange.stream,
+            )
+        except BaseException:
+            exchange.poison()
+            raise
+        try:
+            yield item
+        except BaseException:
+            exchange.poison()
+            raise
 
 
 def sparse_sharded_gramian_blockwise(
@@ -957,6 +1319,8 @@ def sparse_sharded_gramian_blockwise(
     density_threshold=None,
     block_variants=None,
     compute_dtype=None,
+    pipeline_depth: int = 2,
+    coalesce_variants=None,
 ):
     """Stream CSR carrier windows into a mesh-sharded (tiled) Gramian.
 
@@ -1014,6 +1378,7 @@ def sparse_sharded_gramian_blockwise(
         DEFAULT_SPARSE_DENSITY_THRESHOLD,
         _note_window,
         _pad_rows_for_scan,
+        dense_panel_width,
         padded_carrier_matrix,
         window_route,
     )
@@ -1034,15 +1399,33 @@ def sparse_sharded_gramian_blockwise(
         jnp.int8, accum_dtype, compute_dtype
     )
     width = block_variants or DEFAULT_BLOCK_VARIANTS
-    scatter, _accum_dense = _sparse_tile_kernels(
-        mesh,
-        d_axis,
-        m_axis,
-        n_padded,
-        tile_rows,
-        tile_cols,
-        np.dtype(accum_dtype).name,
-        np.dtype(compute_dtype).name,
+    from spark_examples_tpu.ops.scatter_kernel import resolve_scatter_path
+
+    # One scan-vs-Pallas resolution per stream, OUTSIDE any trace; part
+    # of the executable cache key so the env switch is honored per run.
+    scatter_path = resolve_scatter_path(
+        (tile_rows, tile_cols), np.dtype(accum_dtype)
+    )
+    # Square pod tile grids skip the strictly-lower (transpose-
+    # redundant) tiles during accumulation and mirror once at the end —
+    # see _sparse_tile_kernels. Pod-only: the host-local path's G may
+    # feed further host-side merges (allreduce_gramian) per-tile.
+    mirror = (
+        spans and grid_rows == grid_cols and grid_rows > 1
+    )
+    scatter, _accum_dense, _accum_dense_pod, _mirror_fill = (
+        _sparse_tile_kernels(
+            mesh,
+            d_axis,
+            m_axis,
+            n_padded,
+            tile_rows,
+            tile_cols,
+            np.dtype(accum_dtype).name,
+            np.dtype(compute_dtype).name,
+            scatter_path,
+            mirror,
+        )
     )
     idx_sharding = NamedSharding(mesh, P(None, None))
     g = jax.device_put(
@@ -1050,10 +1433,11 @@ def sparse_sharded_gramian_blockwise(
     )
     with obs.span("gramian.sparse.accumulate", n=n_samples, sharded=True):
         if spans:
-            # Pod mode: every step is a collective, so windows arrive
-            # through the per-step synced carrier stream — dense pod
-            # panels use the variant-axis-over-everything layout of
-            # sharded_gramian_blockwise_global.
+            # Pod mode: every step is a collective device program, so
+            # windows arrive through the pipelined synced carrier
+            # stream — dense pod panels use the variant-axis-over-
+            # everything layout and the explicit packed-allgather tile
+            # program (_tile_dense_pod).
             x_sharding = NamedSharding(
                 mesh, P(None, tuple(mesh.axis_names))
             )
@@ -1068,19 +1452,37 @@ def sparse_sharded_gramian_blockwise(
                 v_div,
                 x_sharding,
                 idx_sharding,
+                pipeline_depth=pipeline_depth,
+                coalesce_variants=coalesce_variants,
             )
-            for route, payload, nnz, n_variants in stream:
+            for (
+                route,
+                payload,
+                nnz,
+                n_variants,
+                step,
+                n_win,
+                stream_id,
+            ) in stream:
                 with obs.span(
                     "gramian.sparse.window",
                     route=route,
                     nnz=nnz,
                     variants=n_variants,
+                    step=step,
+                    stream=stream_id,
+                    windows=n_win,
                 ):
                     if route == "scatter":
                         g = scatter(g, payload)
                     else:
-                        g = _accum_dense(g, payload)
-                _note_window(route, nnz)
+                        g = _accum_dense_pod(g, payload)
+                _note_window(route, nnz, count=n_win)
+            if _mirror_fill is not None:
+                # One tile-swap ppermute + transpose reconstructs the
+                # skipped strictly-lower tiles — exact copies, so G
+                # stays bit-identical to the full computation.
+                g = _mirror_fill(g)
         else:
             x_sharding = NamedSharding(mesh, P(d_axis, None))
             lo, hi = addressable_sample_bounds(
@@ -1109,7 +1511,9 @@ def sparse_sharded_gramian_blockwise(
                         )
                         g = scatter(g, jax.device_put(idx, idx_sharding))
                     else:
-                        dense_width = max(width, int(lens.size))
+                        dense_width = dense_panel_width(
+                            int(lens.size), width
+                        )
                         xb = _densify_window(
                             window_idx, lens, n_samples, dense_width
                         )

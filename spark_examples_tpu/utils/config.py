@@ -114,6 +114,19 @@ class PcaConfig(GenomicsConfig):
     # default is the measured crossover with margin (PERFORMANCE.md
     # decision log).
     sparse_density_threshold: float = 0.02
+    # Pod-sparse protocol pipeline depth (process-spanning meshes with
+    # --pca-mode sparse): how many window slots the sync thread's
+    # header/confirm/carrier exchange runs AHEAD of the device scatter,
+    # so exchange latency and payload construction hide behind compute.
+    # 0 = inline lockstep (the ablation/debug mode); 2 (double
+    # buffering) is right unless exchange latency is extreme.
+    pod_pipeline_depth: int = 2
+    # Pod-sparse gang coalescing: consecutive scatter-route windows
+    # merge into one protocol step until their variant-row total
+    # reaches this, so tiny windows amortize one exchange instead of
+    # paying per-window latency. 0 disables; G is bit-identical at any
+    # setting (integer-exact accumulation).
+    pod_coalesce_variants: int = 256
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 64  # shards per Gramian snapshot
     # World-size-independent checkpointing (utils/elastic.py): work units
@@ -505,6 +518,28 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         "same-step windows land on opposite sides of the threshold "
         "fail together (pin the threshold to 0 or large to force one "
         "route on heterogeneous cohorts)",
+    )
+    p.add_argument(
+        "--pod-pipeline-depth",
+        type=int,
+        default=PcaConfig.pod_pipeline_depth,
+        help="Pod-sparse protocol pipeline depth (process-spanning "
+        "meshes, --pca-mode sparse): window slots the host-side "
+        "header/confirm/carrier exchange runs ahead of the device "
+        "scatter, hiding exchange latency and payload construction "
+        "behind compute. 0 = inline lockstep (ablation mode); default "
+        "2 (double buffering). G is bit-identical at any depth",
+    )
+    p.add_argument(
+        "--pod-coalesce-variants",
+        type=int,
+        default=PcaConfig.pod_coalesce_variants,
+        help="Pod-sparse gang coalescing target: consecutive "
+        "scatter-route windows merge into one protocol step until "
+        "their variant-row total reaches this, amortizing one "
+        "exchange over many tiny windows (tail windows, small "
+        "shards). 0 disables coalescing; G is bit-identical at any "
+        "setting",
     )
     p.add_argument(
         "--eig-tol",

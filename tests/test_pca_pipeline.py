@@ -123,6 +123,43 @@ class TestEndToEnd:
         # Dataset label is the callsetId prefix before "-".
         assert {r[0].split("-")[0] for r in result} == {"setA", "setB"}
 
+    def _degenerate_merge_driver(self, mode):
+        """A same-seed two-dataset merge: duplicated sample rows make
+        the centered Gramian exactly rank-deficient — the cohort shape
+        that collapses the fused CholeskyQR panel to NaN."""
+        from spark_examples_tpu.genomics.sources import FixtureSource
+
+        a = synthetic_cohort(8, 60, variant_set_id="setA", seed=1)
+        b = synthetic_cohort(8, 60, variant_set_id="setB", seed=1)
+        merged = FixtureSource(
+            variants=a._variants + b._variants,
+            callsets=a._callsets + b._callsets,
+        )
+        conf = PcaConfig(
+            variant_set_ids=["setA", "setB"], pca_mode=mode
+        )
+        return VariantsPcaDriver(conf, merged)
+
+    def test_degenerate_cohort_auto_falls_back_to_dense_eigh(self):
+        """AUTO selection must not die on a numerically degenerate
+        centered Gramian: the fused finish's panel collapse warns and
+        falls back to dense eigh (exact on rank-deficient spectra),
+        finishing with finite coordinates — the fix for the historical
+        multi-dataset/elastic tier-1 failure family."""
+        driver = self._degenerate_merge_driver("auto")
+        with pytest.warns(UserWarning, match="dense-eigh finish"):
+            result = driver.run()
+        coords = np.array([r[1:] for r in result])
+        assert np.isfinite(coords).all()
+        assert len(result) == 16
+
+    def test_degenerate_cohort_forced_fused_still_raises(self):
+        """--pca-mode fused asked for exactly that program: the
+        degenerate-panel collapse stays a hard error there."""
+        driver = self._degenerate_merge_driver("fused")
+        with pytest.raises(FloatingPointError, match="non-finite"):
+            driver.run()
+
 
 class TestCli:
     def test_cli_pca_fixture(self, capsys, tmp_path):

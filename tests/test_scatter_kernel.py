@@ -1,0 +1,239 @@
+"""Pallas scatter-accumulate kernel suite (ops/scatter_kernel.py).
+
+Pins the one-hot-count outer-product formulation bit-identical to the
+chunked-scan scatter across OOB sentinels, duplicate carriers, row
+blocking, and k buckets — in interpreter mode, so the contract is
+testable on the CPU container — plus the dispatcher's env kill switch /
+auto-resolution semantics and the end-to-end sparse-engine integration
+(``SPARK_EXAMPLES_TPU_SCATTER_KERNEL=interpret`` matches the dense
+reference through both the single-device and mesh-sharded
+accumulators).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_examples_tpu.arrays.blocks import csr_windows
+from spark_examples_tpu.ops.gramian import gramian
+from spark_examples_tpu.ops.scatter_kernel import (
+    kernel_block_rows,
+    resolve_scatter_path,
+    scatter_pairs_kernel,
+)
+from spark_examples_tpu.ops.sparse import (
+    SCATTER_CHUNK_VARIANTS,
+    scatter_pairs_chunked,
+    sparse_gramian_blockwise,
+)
+from spark_examples_tpu.parallel.mesh import make_mesh
+from spark_examples_tpu.parallel.sharded import (
+    _sparse_tile_kernels,
+    sparse_sharded_gramian_blockwise,
+)
+
+from tests.test_sparse_gramian import cohort_csr
+
+
+def _random_case(rng, t_r, t_c, v, k, oob_frac=0.2):
+    row = rng.integers(0, t_r, size=(v, k)).astype(np.int32)
+    col = rng.integers(0, t_c, size=(v, k)).astype(np.int32)
+    # Sprinkle OOB sentinels the way the tile re-base does (any index
+    # >= the axis size is dropped).
+    row[rng.random((v, k)) < oob_frac] = t_r
+    col[rng.random((v, k)) < oob_frac] = t_c + 7
+    g = rng.integers(0, 9, size=(t_r, t_c)).astype(np.float32)
+    return jnp.asarray(g), jnp.asarray(row), jnp.asarray(col)
+
+
+class TestKernelBitIdentity:
+    @pytest.mark.parametrize(
+        "t_r,t_c,k",
+        [
+            (8, 128, 8),
+            (64, 128, 16),
+            (64, 256, 64),
+            (128, 128, 8),
+        ],
+    )
+    def test_matches_scan_across_geometries(self, t_r, t_c, k):
+        rng = np.random.default_rng(t_r + t_c + k)
+        g, row, col = _random_case(rng, t_r, t_c, SCATTER_CHUNK_VARIANTS * 2, k)
+        a = np.asarray(scatter_pairs_chunked(g, row, col))
+        b = np.asarray(scatter_pairs_kernel(g, row, col, interpret=True))
+        np.testing.assert_array_equal(a, b)
+
+    def test_duplicate_pairs_accumulate_multiply(self):
+        # Same (row, col) pair repeated within one variant: scatter-add
+        # applies every +1; the one-hot COUNT formulation must too.
+        v = SCATTER_CHUNK_VARIANTS
+        row = np.full((v, 8), 8, np.int32)  # all OOB (t_r = 8)
+        col = np.full((v, 8), 200, np.int32)
+        row[0, :4] = 3
+        col[0, :4] = 77
+        g = jnp.zeros((8, 128), jnp.float32)
+        out = np.asarray(
+            scatter_pairs_kernel(
+                g, jnp.asarray(row), jnp.asarray(col), interpret=True
+            )
+        )
+        assert out[3, 77] == 16.0  # 4 row hits x 4 col hits
+        assert out.sum() == 16.0
+
+    def test_all_sentinel_is_inert(self):
+        v = SCATTER_CHUNK_VARIANTS
+        row = np.full((v, 16), 64, np.int32)
+        col = np.full((v, 16), 128, np.int32)
+        g0 = np.arange(64 * 128, dtype=np.float32).reshape(64, 128)
+        out = np.asarray(
+            scatter_pairs_kernel(
+                jnp.asarray(g0),
+                jnp.asarray(row),
+                jnp.asarray(col),
+                interpret=True,
+            )
+        )
+        np.testing.assert_array_equal(out, g0)
+
+    def test_row_blocking_covers_tall_tiles(self, monkeypatch):
+        # Force a tiny VMEM budget so the kernel must grid over row
+        # blocks — the accumulating block is revisited per chunk and
+        # the result must not change.
+        rng = np.random.default_rng(5)
+        g, row, col = _random_case(
+            rng, 64, 128, SCATTER_CHUNK_VARIANTS * 2, 16
+        )
+        want = np.asarray(scatter_pairs_chunked(g, row, col))
+        monkeypatch.setenv(
+            "SPARK_EXAMPLES_TPU_SCATTER_KERNEL_VMEM",
+            str(SCATTER_CHUNK_VARIANTS * 128 * 4 + 2 * 8 * 128 * 4
+                + SCATTER_CHUNK_VARIANTS * 8 * 4
+                + 2 * SCATTER_CHUNK_VARIANTS * 16 * 4),
+        )
+        assert kernel_block_rows(64, 128, 16) == 8
+        got = np.asarray(
+            scatter_pairs_kernel(g, row, col, interpret=True)
+        )
+        np.testing.assert_array_equal(want, got)
+
+    def test_oversized_carrier_bucket_falls_back_in_dispatch(
+        self, monkeypatch
+    ):
+        """The resolve-time budget check cannot see K (it varies per
+        window): a carrier bucket whose (C, K) index blocks blow the
+        budget must fall back to the scan body INSIDE the dispatch,
+        bit-identically — never a Mosaic staging error mid-stream."""
+        rng = np.random.default_rng(9)
+        k = 64
+        g, row, col = _random_case(
+            rng, 64, 128, SCATTER_CHUNK_VARIANTS, k
+        )
+        # Budget passes the resolve-time check (k unknown → 0) but not
+        # the dispatch's real-K check.
+        budget = (
+            SCATTER_CHUNK_VARIANTS * 128 * 4 + 2 * 8 * 128 * 4
+            + SCATTER_CHUNK_VARIANTS * 8 * 4 + 1024
+        )
+        monkeypatch.setenv(
+            "SPARK_EXAMPLES_TPU_SCATTER_KERNEL_VMEM", str(budget)
+        )
+        monkeypatch.setenv(
+            "SPARK_EXAMPLES_TPU_SCATTER_KERNEL", "interpret"
+        )
+        assert resolve_scatter_path((64, 128)) == "interpret"
+        assert kernel_block_rows(64, 128, k) is None
+        want = np.asarray(scatter_pairs_chunked(g, row, col))
+        got = np.asarray(
+            scatter_pairs_kernel(g, row, col, interpret=True)
+        )
+        np.testing.assert_array_equal(want, got)
+
+
+class TestDispatcher:
+    def test_kill_switch_forces_scan(self, monkeypatch):
+        monkeypatch.setenv("SPARK_EXAMPLES_TPU_SCATTER_KERNEL", "0")
+        assert resolve_scatter_path((64, 128)) == "scan"
+
+    def test_interpret_mode_forced(self, monkeypatch):
+        monkeypatch.setenv(
+            "SPARK_EXAMPLES_TPU_SCATTER_KERNEL", "interpret"
+        )
+        assert resolve_scatter_path((64, 128)) == "interpret"
+
+    def test_auto_on_cpu_is_scan(self, monkeypatch):
+        monkeypatch.delenv(
+            "SPARK_EXAMPLES_TPU_SCATTER_KERNEL", raising=False
+        )
+        # No Mosaic backend on the CPU container: the compiled kernel
+        # never engages; the exact historical executable does.
+        assert resolve_scatter_path((64, 128)) == "scan"
+
+    def test_ineligible_geometry_falls_back(self, monkeypatch):
+        monkeypatch.setenv(
+            "SPARK_EXAMPLES_TPU_SCATTER_KERNEL", "interpret"
+        )
+        # Lane-unaligned tile / non-f32 accumulator: scan.
+        assert resolve_scatter_path((37, 37)) == "scan"
+        assert (
+            resolve_scatter_path((64, 128), np.float64) == "scan"
+        )
+
+    def test_vmem_budget_guard(self, monkeypatch):
+        monkeypatch.setenv(
+            "SPARK_EXAMPLES_TPU_SCATTER_KERNEL_VMEM", "1024"
+        )
+        assert kernel_block_rows(64, 128) is None
+        monkeypatch.setenv(
+            "SPARK_EXAMPLES_TPU_SCATTER_KERNEL", "interpret"
+        )
+        assert resolve_scatter_path((64, 128)) == "scan"
+
+
+class TestEngineIntegration:
+    """The kernel through the real accumulators: bit-identical G."""
+
+    def test_single_device_engine_matches_dense(self, monkeypatch):
+        n = 128  # lane-aligned so the interpret path engages
+        x, pair = cohort_csr(n, 300, density=0.03, seed=11)
+        want = np.asarray(gramian(x))
+        monkeypatch.setenv(
+            "SPARK_EXAMPLES_TPU_SCATTER_KERNEL", "interpret"
+        )
+        assert resolve_scatter_path((n, n)) == "interpret"
+        got = np.asarray(
+            sparse_gramian_blockwise(
+                csr_windows(iter([pair]), 64), n, block_variants=64
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_mesh_engine_matches_dense(self, monkeypatch):
+        n = 256  # 2x2 mesh -> (128, 128) tiles, kernel-eligible
+        x, pair = cohort_csr(n, 256, density=0.02, seed=12)
+        want = np.asarray(gramian(x))
+        mesh = make_mesh("data:2,model:2")
+        monkeypatch.setenv(
+            "SPARK_EXAMPLES_TPU_SCATTER_KERNEL", "interpret"
+        )
+        got = np.asarray(
+            sparse_sharded_gramian_blockwise(
+                csr_windows(iter([pair]), 64),
+                n,
+                mesh,
+                block_variants=64,
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_kernel_path_is_part_of_executable_cache_key(self):
+        mesh = make_mesh("data:2,model:2")
+        a = _sparse_tile_kernels(
+            mesh, "data", "model", 256, 128, 128, "float32",
+            "int8", "scan",
+        )
+        b = _sparse_tile_kernels(
+            mesh, "data", "model", 256, 128, 128, "float32",
+            "int8", "interpret",
+        )
+        assert a is not b  # distinct cached kernel sets per path

@@ -398,6 +398,26 @@ class TestDriverSparseMode:
         with pytest.raises(ValueError, match="sparse-density-threshold"):
             self._driver("sparse", sparse_density_threshold=-0.1)
 
+    def test_negative_pipeline_depth_rejected(self):
+        with pytest.raises(ValueError, match="pod-pipeline-depth"):
+            self._driver("sparse", pod_pipeline_depth=-1)
+
+    def test_negative_coalesce_rejected(self):
+        with pytest.raises(ValueError, match="pod-coalesce-variants"):
+            self._driver("sparse", pod_coalesce_variants=-8)
+
+    def test_dense_panel_width_buckets(self):
+        from spark_examples_tpu.ops.sparse import dense_panel_width
+
+        # Power-of-two bucket, min 8, capped at the block width; a
+        # wider-than-block window (direct API use) keeps exact rows.
+        assert dense_panel_width(512, 8192) == 512
+        assert dense_panel_width(513, 8192) == 1024
+        assert dense_panel_width(0, 8192) == 8
+        assert dense_panel_width(3, 32) == 8
+        assert dense_panel_width(8192, 8192) == 8192
+        assert dense_panel_width(9000, 8192) == 9000
+
     def test_rare_variant_af_out_of_range_rejected(self):
         # af > 2/3 would silently saturate carrier probability past 1
         # (an all-carrier "rare" cohort); af <= 0 an all-zero one.
@@ -596,6 +616,56 @@ class TestSchemaDrift:
         errs = validate.validate_metrics(str(bad))
         assert errs and "outcome" in errs[0]
 
+    def test_slot_span_is_schema_known(self, tmp_path):
+        trace = tmp_path / "t.json"
+        trace.write_text(
+            json.dumps(
+                {
+                    "traceEvents": [
+                        {
+                            "ph": "X",
+                            "name": "gramian.sparse.slot",
+                            "pid": 1,
+                            "ts": 0,
+                            "dur": 1,
+                        }
+                    ]
+                }
+            )
+        )
+        assert validate.validate_trace(str(trace)) == []
+
+    def test_unknown_pipeline_span_rejected(self, tmp_path):
+        trace = tmp_path / "t.json"
+        trace.write_text(
+            json.dumps(
+                {
+                    "traceEvents": [
+                        {
+                            "ph": "X",
+                            "name": "gramian.sparse.pipeline_slot",
+                            "pid": 1,
+                            "ts": 0,
+                            "dur": 1,
+                        }
+                    ]
+                }
+            )
+        )
+        errs = validate.validate_trace(str(trace))
+        assert errs and "gramian.sparse.pipeline_slot" in errs[0]
+
+    def test_coalesce_counter_requires_mode_label(self, tmp_path):
+        good = tmp_path / "good.prom"
+        good.write_text(
+            'sparse_pod_coalesced_windows_total{mode="gang"} 12\n'
+        )
+        assert validate.validate_metrics(str(good)) == []
+        bad = tmp_path / "bad.prom"
+        bad.write_text("sparse_pod_coalesced_windows_total 12\n")
+        errs = validate.validate_metrics(str(bad))
+        assert errs and "mode" in errs[0]
+
 
 # ---------------------------------------------------------------------------
 # Process-spanning (pod) sparse protocol: subprocess-spawned
@@ -757,6 +827,74 @@ _POD_SPARSE_WORKER = textwrap.dedent(
     assert g4.is_fully_addressable
     out["g_hostlocal_merged"] = np.asarray(allreduce_gramian(g4)).tolist()
 
+    # 5. Pipeline-depth ablation: depth 0 (inline lockstep) and a deep
+    # pipeline produce bit-identical G — the pipeline changes WHEN the
+    # exchange runs, never what accumulates.
+    g5 = sparse_sharded_gramian_blockwise(
+        iter(mine), n, mesh, block_variants=32, pipeline_depth=0
+    )
+    out["g_depth0"] = np.asarray(replicate(g5)).tolist()
+    g6 = sparse_sharded_gramian_blockwise(
+        iter(mine), n, mesh, block_variants=32, pipeline_depth=4
+    )
+    out["g_depth4"] = np.asarray(replicate(g6)).tolist()
+
+    # 6. Coalesced-gang bit-identity: many TINY windows (well under the
+    # coalesce target) merge into multi-window gangs; shuffled local
+    # orders and different coalesce settings all land on the same G,
+    # and the gang/solo counter records the split.
+    gang_counter = obs.get_registry().counter(
+        "sparse_pod_coalesced_windows_total",
+        "Local CSR windows entering pod-sparse protocol steps, by "
+        "gang/solo coalescing outcome",
+    )
+    tiny = list(csr_windows(iter([pair]), 4))  # 4-variant windows
+    mine_tiny = tiny[pid::world]
+    before_gang = {
+        m: gang_counter.labels(mode=m).value for m in ("gang", "solo")
+    }
+    for coalesce, key in ((0, "g_solo"), (64, "g_gang")):
+        rng = np.random.default_rng(11 + pid + coalesce)
+        shuffled_tiny = [
+            mine_tiny[i] for i in rng.permutation(len(mine_tiny))
+        ]
+        gg = sparse_sharded_gramian_blockwise(
+            iter(shuffled_tiny), n, mesh, block_variants=4,
+            coalesce_variants=coalesce,
+            density_threshold=1.01,  # all-scatter: gangs can form
+        )
+        out[key] = np.asarray(replicate(gg)).tolist()
+    after_gang = {
+        m: gang_counter.labels(mode=m).value for m in ("gang", "solo")
+    }
+    out["gang_delta"] = {
+        m: after_gang[m] - before_gang[m] for m in ("gang", "solo")
+    }
+    out["tiny_windows"] = len(mine_tiny)
+
+    # 7. Overlap proof on the emitted trace: with the pipelined stream,
+    # some step w+1 exchange span must BEGIN before step w's scatter
+    # span ENDS (the serialization MULTICHIP_r06 paid is gone).
+    from spark_examples_tpu.obs import telemetry_session
+    trace_path = sys.argv[1] + f".trace.{pid}.json"
+    with telemetry_session(trace_out=trace_path):
+        sparse_sharded_gramian_blockwise(
+            iter(mine), n, mesh, block_variants=32
+        )
+    import spark_examples_tpu as _pkg
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(_pkg.__file__))),
+        "scripts",
+    ))
+    import validate_trace as _vt
+    evs = json.load(open(trace_path))["traceEvents"]
+    out["overlap_proven"] = _vt.sparse_overlap_proven(evs)
+    out["slot_spans"] = sum(
+        1
+        for e in evs
+        if e.get("ph") == "X" and e["name"] == "gramian.sparse.slot"
+    )
+
     if pid == 0:
         with open(sys.argv[1], "w") as f:
             json.dump(out, f)
@@ -783,52 +921,73 @@ _POD_CHAOS_WORKER = textwrap.dedent(
     pid, world = jax.process_index(), jax.process_count()
     mesh = Mesh(np.array(jax.devices()).reshape(world, 2), ("data", "model"))
     results = {}
+    DEPTH = 2
+    # Failure positions 0..3 cover every in-flight slot of the depth-2
+    # pipeline (position 0 = first slot, 3 = past the staged window).
+    POSITIONS = [0, 1, 2, 3]
 
     def win(idx, lens):
         return np.asarray(idx, np.int64), np.asarray(lens, np.int64)
 
-    # A. Producer exception on ONE process mid-stream must raise on
-    # EVERY process together — never a stranded peer in the collective.
-    def failing(pid):
-        yield win([1, 2], [2])
+    def good(i):
+        # Distinct tiny scatter windows (threshold 1.01 -> scatter).
+        return win([i % 9], [1])
+
+    # A. Producer exception on ONE process at slot position p must
+    # raise on EVERY process together, at the same step — never a
+    # stranded peer, whatever the pipeline had in flight.
+    def failing(pid, p):
+        for i in range(p):
+            yield good(i)
         if pid == 0:
             raise IOError("injected mid-stream ingest failure")
-        yield win([3], [1])
+        yield good(p)
 
-    try:
-        sparse_sharded_gramian_blockwise(failing(pid), 9, mesh)
-        results["chaos"] = False
-    except RuntimeError as e:
-        ok = "carrier stream failed on process(es) [0]" in str(e)
-        if pid == 0:
-            ok = ok and isinstance(e.__cause__, IOError)
-        else:
-            ok = ok and e.__cause__ is None
-        results["chaos"] = ok
+    results["chaos"] = []
+    for p in POSITIONS:
+        try:
+            sparse_sharded_gramian_blockwise(
+                failing(pid, p), 9, mesh, density_threshold=1.01,
+                pipeline_depth=DEPTH, coalesce_variants=0,
+            )
+            results["chaos"].append(False)
+        except RuntimeError as e:
+            ok = "carrier stream failed on process(es) [0]" in str(e)
+            if pid == 0:
+                ok = ok and isinstance(e.__cause__, IOError)
+            else:
+                ok = ok and e.__cause__ is None
+            results["chaos"].append(ok)
 
-    # B. Same-step route divergence (one process's window densifies,
-    # the peers' scatter) is a per-window GLOBAL decision: ValueError
-    # on every process together.
-    def divergent(pid):
+    # B. Same-step route divergence at slot position p (one process's
+    # window densifies, the peers' scatter) is a per-window GLOBAL
+    # decision: ValueError on every process together.
+    def divergent(pid, p):
+        for i in range(p):
+            yield good(i)
         if pid == 0:
             yield win(np.arange(6), [6])  # density 6/9 -> dense
         else:
             yield win([0], [1])           # density 1/9 -> scatter
-    try:
-        sparse_sharded_gramian_blockwise(
-            divergent(pid), 9, mesh, density_threshold=0.5
-        )
-        results["divergence"] = False
-    except ValueError as e:
-        results["divergence"] = (
-            "density route" in str(e)
-            and "--sparse-density-threshold" in str(e)
-        )
+    results["divergence"] = []
+    for p in POSITIONS:
+        try:
+            sparse_sharded_gramian_blockwise(
+                divergent(pid, p), 9, mesh, density_threshold=0.5,
+                pipeline_depth=DEPTH, coalesce_variants=0,
+            )
+            results["divergence"].append(False)
+        except ValueError as e:
+            results["divergence"].append(
+                "density route" in str(e)
+                and "--sparse-density-threshold" in str(e)
+            )
 
     # C. Payload construction failure AFTER the header sync (the
-    # densify-OOM shape): _densify_window raises on process 0 only —
-    # the payload-confirm allgather must turn it into an all-process
-    # raise instead of stranding process 1 in the payload collective.
+    # densify-OOM shape) at slot position p: _densify_window raises on
+    # process 0 only — the payload-confirm exchange must turn it into
+    # an all-process raise instead of stranding peers in the payload
+    # phase.
     from spark_examples_tpu.arrays import blocks as _blocks
 
     real_densify = _blocks._densify_window
@@ -836,30 +995,37 @@ _POD_CHAOS_WORKER = textwrap.dedent(
     def _oom(*a, **k):
         raise MemoryError("injected densify failure")
 
-    if pid == 0:
-        _blocks._densify_window = _oom
-    try:
-        sparse_sharded_gramian_blockwise(
-            iter([win(np.arange(12), [12])]),  # 12/19 >= 0.5 -> dense
-            19,
-            mesh,
-            density_threshold=0.5,
-        )
-        results["payload"] = False
-    except RuntimeError as e:
-        ok = (
-            "carrier payload construction failed on process(es) [0]"
-            in str(e)
-        )
+    def dense_tail(p):
+        for i in range(p):
+            yield win([i % 19], [1])      # 1/19 < 0.5 -> scatter
+        yield win(np.arange(12), [12])    # 12/19 >= 0.5 -> dense
+    results["payload"] = []
+    for p in POSITIONS:
         if pid == 0:
-            ok = ok and isinstance(e.__cause__, MemoryError)
-        else:
-            ok = ok and e.__cause__ is None
-        results["payload"] = ok
-    finally:
-        _blocks._densify_window = real_densify
+            _blocks._densify_window = _oom
+        try:
+            sparse_sharded_gramian_blockwise(
+                dense_tail(p), 19, mesh, density_threshold=0.5,
+                pipeline_depth=DEPTH, coalesce_variants=0,
+            )
+            results["payload"].append(False)
+        except RuntimeError as e:
+            ok = (
+                "carrier payload construction failed on process(es) [0]"
+                in str(e)
+            )
+            if pid == 0:
+                ok = ok and isinstance(e.__cause__, MemoryError)
+            else:
+                ok = ok and e.__cause__ is None
+            results["payload"].append(ok)
+        finally:
+            _blocks._densify_window = real_densify
 
-    # D. The sync counter recorded every outcome on every process.
+    # D. The sync counter recorded every outcome on every process:
+    # one producer-error per A and per C scenario, one
+    # route-divergence per B scenario, and exactly the good slots
+    # BEFORE each failure as synced (sum over positions, x3 kinds).
     counter = obs.get_registry().counter(
         "sparse_pod_sync_total",
         "Pod-sparse per-window sync steps (header + carrier allgather) "
@@ -868,6 +1034,11 @@ _POD_CHAOS_WORKER = textwrap.dedent(
     results["outcomes"] = {
         o: counter.labels(outcome=o).value
         for o in ("synced", "producer-error", "route-divergence")
+    }
+    results["expected"] = {
+        "synced": 3 * sum(POSITIONS),
+        "producer-error": 2 * len(POSITIONS),
+        "route-divergence": len(POSITIONS),
     }
     with open(sys.argv[1] + f".{pid}", "w") as f:
         json.dump(results, f)
@@ -929,25 +1100,50 @@ class TestPodSparseProtocol:
             np.asarray(result["g_hostlocal_merged"]), want
         )
 
-    def test_pod_failure_sync_chaos(self, tmp_path):
+        # Pipeline-depth ablation: inline lockstep (0) and a deep
+        # pipeline (4) are bit-identical to the default-depth run.
+        np.testing.assert_array_equal(np.asarray(result["g_depth0"]), want)
+        np.testing.assert_array_equal(np.asarray(result["g_depth4"]), want)
+
+        # Coalesced gangs: tiny windows, shuffled per-process orders,
+        # with coalescing off and on — bit-identical G both ways, and
+        # the gang/solo counter recorded every window on the right
+        # side (no 1-window gangs at these sizes: 4-variant windows
+        # against a 64-variant target).
+        np.testing.assert_array_equal(np.asarray(result["g_solo"]), want)
+        np.testing.assert_array_equal(np.asarray(result["g_gang"]), want)
+        assert result["gang_delta"] == {
+            "gang": result["tiny_windows"],
+            "solo": result["tiny_windows"],
+        }
+
+        # The pipelined stream PROVABLY overlapped: a step w+1 exchange
+        # span began before step w's scatter span ended, and slot spans
+        # made it onto the timeline.
+        assert result["overlap_proven"] is True
+        assert result["slot_spans"] >= 2
+
+    @pytest.mark.parametrize("nprocs", [2, 4])
+    def test_pod_failure_sync_chaos(self, tmp_path, nprocs):
         """One-sided producer failures (mid-stream AND post-header
-        payload construction) and same-step route divergence raise on
-        EVERY process together — the run completes (no hang) under the
-        harness's hard timeout."""
+        payload construction) and same-step route divergence, injected
+        at EVERY in-flight slot position of the depth-2 pipeline, raise
+        on EVERY process together — each run completes (no hang) under
+        the harness's hard timeout, and the per-outcome sync counters
+        account for exactly the slots that completed before each
+        failure."""
+        if nprocs > (os.cpu_count() or 1) * 4:
+            pytest.skip("not enough cores to host the pod-sim")
         script = tmp_path / "worker.py"
         script.write_text(_POD_CHAOS_WORKER)
         out_file = tmp_path / "result.json"
-        _run_pod_workers(script, [out_file], n=2, timeout=240)
-        for pid in (0, 1):
+        _run_pod_workers(script, [out_file], n=nprocs, timeout=240)
+        for pid in range(nprocs):
             r = json.loads((tmp_path / f"result.json.{pid}").read_text())
-            assert r["chaos"], r
-            assert r["divergence"], r
-            assert r["payload"], r
-            assert r["outcomes"]["synced"] >= 1, r
-            # One from the mid-stream producer exception, one from the
-            # post-header payload-construction failure.
-            assert r["outcomes"]["producer-error"] == 2, r
-            assert r["outcomes"]["route-divergence"] == 1, r
+            assert all(r["chaos"]), r
+            assert all(r["divergence"]), r
+            assert all(r["payload"]), r
+            assert r["outcomes"] == r["expected"], r
 
 
 @pytest.mark.slow
