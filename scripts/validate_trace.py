@@ -60,6 +60,10 @@ _INGEST_SPANS = {
 _JOB_SPANS = {
     "job.run",     # one job's execution (ingest -> gramian -> pca)
     "job.replay",  # crash-recovery journal replay at tier startup
+    "job.delta",   # one cached-ancestor rank-k Gramian correction
+                   # (added/removed sample counts in args)
+    "job.gang",    # one gang-batched Gramian dispatch (size + member
+                   # job ids in args)
 }
 
 # Sparse-aware Gramian span contract (ops/sparse.py + the mesh-tiled
@@ -225,6 +229,7 @@ _INGEST_HISTOGRAM = "ingest_block_build_seconds"
 _LABELED_COUNTERS = {
     "breaker_probe_total": "outcome",     # half-open probe outcomes
     "cold_stream_shards_total": "stage",  # fetched/accumulated per shard
+    "serving_delta_jobs_total": "outcome",  # hit/fallback/miss
     "serving_jobs_total": "outcome",      # done/failed/cached/deduped
     "serving_shed_total": "reason",       # queue_full/quota
     "sparse_gramian_windows_total": "route",  # scatter/dense per window
@@ -232,6 +237,12 @@ _LABELED_COUNTERS = {
     "sparse_pod_sync_total": "outcome",   # synced/drained/producer-error/
                                           # route-divergence/dtype-divergence
 }
+
+# Serving-tier plain histograms: no label contract, but when present
+# the full Prometheus triplet must be exposed, and GL003 requires a
+# live registration site for each name (a renamed emission can never
+# leave a dead schema entry).
+_SERVING_HISTOGRAMS = ("serving_gang_size",)
 
 
 def _check_wire_metrics(path: str, sample_lines: List[str]) -> List[str]:
@@ -258,7 +269,11 @@ def _check_wire_metrics(path: str, sample_lines: List[str]) -> List[str]:
                 f"{path}: {name} sample missing its {required} label: "
                 f"{line!r}"
             )
-    for hist in (_WIRE_HISTOGRAM, _INGEST_HISTOGRAM):
+    for hist in (
+        _WIRE_HISTOGRAM,
+        _INGEST_HISTOGRAM,
+        *_SERVING_HISTOGRAMS,
+    ):
         if f"{hist}_bucket" in names:
             for suffix in ("_sum", "_count"):
                 if f"{hist}{suffix}" not in names:

@@ -390,6 +390,14 @@ def _analysis_tier(args, source):
     ):
         if value < 1:
             raise SystemExit(f"{flag} must be >= 1, got {value}")
+    for flag, value in (
+        ("--delta-max-samples", args.delta_max_samples),
+        ("--gang-max-samples", args.gang_max_samples),
+    ):
+        if value < 0:
+            raise SystemExit(
+                f"{flag} must be >= 0 (0 disables), got {value}"
+            )
     # Jobs jit-compile on demand; the persistent cache means job #1
     # after a restart pays no recompile either.
     _enable_compile_cache()
@@ -408,13 +416,18 @@ def _analysis_tier(args, source):
             file=sys.stderr,
         )
     tier = AnalysisJobTier(
-        AnalysisEngine(source, mesh=mesh),
+        AnalysisEngine(
+            source,
+            mesh=mesh,
+            delta_max_samples=args.delta_max_samples,
+        ),
         base,
         queue_depth=args.analyze_queue_depth,
         tenant_quota=args.analyze_tenant_quota,
         workers=args.analyze_workers,
         journal_dir=args.analyze_journal_dir,
         cache_size=args.analyze_cache_size,
+        gang_max_samples=args.gang_max_samples,
     )
     return tier.start()
 
@@ -490,6 +503,16 @@ def _cmd_serve_cohort(args) -> int:
                     f", journal {args.analyze_journal_dir}"
                     if args.analyze_journal_dir
                     else " (no journal)"
+                )
+                + (
+                    f", deltas <= {args.delta_max_samples} samples"
+                    if args.delta_max_samples > 0
+                    else ", deltas off"
+                )
+                + (
+                    f", gangs <= {args.gang_max_samples} samples"
+                    if args.gang_max_samples > 0
+                    else ", gangs off"
                 ),
                 flush=True,
             )

@@ -10,7 +10,9 @@ exactly what XLA wants: every downstream array shape is known at trace time.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from spark_examples_tpu.genomics.sources import VariantSource
 
@@ -39,6 +41,52 @@ class CallsetIndex:
                     names[cs.id] = cs.name
         print(f"Matrix size: {len(indexes)}")  # VariantsCommon.scala:48
         return CallsetIndex(indexes=indexes, names=names)
+
+    def restricted(
+        self,
+        samples: Optional[Sequence[str]] = None,
+        exclude_samples: Optional[Sequence[str]] = None,
+    ) -> Tuple["CallsetIndex", np.ndarray]:
+        """Cohort sample restriction → ``(sub_index, remap)``.
+
+        ``samples`` keeps only the named callset ids (None = all);
+        ``exclude_samples`` then drops ids. The restricted index
+        preserves FULL-index listing order (so permuted sample lists
+        are one cohort, and the dense numbering stays deterministic);
+        ``remap`` maps full dense index → restricted dense index, with
+        ``-1`` for dropped samples — the one array every ingest stream
+        is filtered through. Unknown ids are a loud error, like the
+        reference's unknown-callset hard error.
+        """
+        known = set(self.indexes)
+        unknown = sorted(
+            set(samples or ()) - known
+        ) + sorted(set(exclude_samples or ()) - known)
+        if unknown:
+            raise ValueError(
+                f"unknown sample callset id(s) in cohort restriction: "
+                f"{unknown[:8]}{'...' if len(unknown) > 8 else ''}"
+            )
+        # None = all samples; an EXPLICIT empty list falls through to
+        # the loud empty-cohort error below.
+        keep = known if samples is None else set(samples)
+        keep -= set(exclude_samples or ())
+        if not keep:
+            raise ValueError(
+                "cohort restriction leaves no samples "
+                "(samples minus exclude_samples is empty)"
+            )
+        remap = np.full(len(self.indexes), -1, dtype=np.int64)
+        indexes: Dict[str, int] = {}
+        names: Dict[str, str] = {}
+        for cid, idx in sorted(
+            self.indexes.items(), key=lambda kv: kv[1]
+        ):
+            if cid in keep:
+                remap[idx] = len(indexes)
+                indexes[cid] = len(indexes)
+                names[cid] = self.names[cid]
+        return CallsetIndex(indexes=indexes, names=names), remap
 
     def name_of_index(self) -> List[str]:
         """Dense index → sample name (for result emission)."""

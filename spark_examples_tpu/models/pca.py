@@ -142,6 +142,29 @@ class VariantsPcaDriver:
                 "non---precise run (use --pca-mode auto to fall back "
                 "automatically)"
             )
+        # `samples is not None` rather than truthiness: an EXPLICITLY
+        # empty include list is a contradictory cohort and must hit the
+        # loud "leaves no samples" error, never silently run the full
+        # cohort. (An empty exclude list excludes nothing — that IS the
+        # unrestricted cohort.)
+        restricted = getattr(conf, "samples", None) is not None or bool(
+            getattr(conf, "exclude_samples", None)
+        )
+        if restricted and conf.checkpoint_dir:
+            # Snapshot digests don't cover the sample restriction yet,
+            # and a restricted resume against an unrestricted snapshot
+            # would be silently wrong — refuse before ingest.
+            raise ValueError(
+                "--samples/--exclude-samples do not compose with "
+                "checkpointed ingest; drop --checkpoint-dir"
+            )
+        if restricted and mesh is not None:
+            # Mesh tiling/sample-range contracts are full-frame; the
+            # serving tier that drives restriction is meshless.
+            raise ValueError(
+                "--samples/--exclude-samples require a meshless run "
+                "(drop --mesh-shape)"
+            )
         self.conf = conf
         self.source = source
         self.mesh = mesh
@@ -154,6 +177,19 @@ class VariantsPcaDriver:
             if index is not None
             else CallsetIndex.from_source(source, conf.variant_set_ids)
         )
+        # The COHORT frame: ingest always extracts in the full index
+        # frame (unknown callsets stay a hard error there), and a
+        # sample restriction remaps/filters carriers at the window
+        # boundary — `self.cohort` is what the Gramian, the finish, and
+        # emission are sized by; `_sample_remap` (full dense index →
+        # cohort index, -1 drops) is the one filter array.
+        if restricted:
+            self.cohort, self._sample_remap = self.index.restricted(
+                getattr(conf, "samples", None),
+                getattr(conf, "exclude_samples", None),
+            )
+        else:
+            self.cohort, self._sample_remap = self.index, None
         self._pin_g_jit = None  # compiled-once G-resharding (pod snapshots)
         self._speculated_shards = 0  # straggler duplicates launched
 
@@ -591,10 +627,64 @@ class VariantsPcaDriver:
         """
         if self.conf.sample_sharded is not None:
             return self.conf.sample_sharded
-        return self.index.size > self.conf.sample_shard_threshold
+        return self.cohort.size > self.conf.sample_shard_threshold
+
+    # -- cohort sample restriction (the window-boundary filter) -------------
+
+    def _restrict_calls(self, calls_iter):
+        """Full-frame per-variant carrier lists → cohort frame (lists
+        with no cohort carrier drop, matching calls_stream's no-carrier
+        drop; G is unaffected either way — empty columns are inert)."""
+        remap = self._sample_remap
+        if remap is None:
+            yield from calls_iter
+            return
+        for calls in calls_iter:
+            mapped = [int(remap[i]) for i in calls if remap[i] >= 0]
+            if mapped:
+                yield mapped
+
+    def _restrict_csr(self, pairs):
+        """Full-frame per-shard ``(indices, offsets)`` CSR pairs →
+        cohort frame, vectorized (drop + renumber carriers; empty rows
+        kept so window composition stays arrival-order-only)."""
+        remap = self._sample_remap
+        if remap is None:
+            yield from pairs
+            return
+        for pair in pairs:
+            if pair is None:
+                continue
+            indices, offsets = pair
+            offsets = np.asarray(offsets, dtype=np.int64)
+            if offsets.size <= 1:
+                continue
+            mapped = remap[np.asarray(indices, dtype=np.int64)]
+            keep = mapped >= 0
+            kept = np.zeros(mapped.size + 1, dtype=np.int64)
+            np.cumsum(keep, out=kept[1:])
+            yield mapped[keep], kept[offsets]
+
+    def _restrict_window(self, window):
+        """One full-frame ``(indices, lens)`` CSR window → cohort frame
+        (the per-window twin of :meth:`_restrict_csr`, used where the
+        full-frame stream is shared — delta capture)."""
+        remap = self._sample_remap
+        if remap is None:
+            return window
+        window_idx, lens = window
+        window_idx = np.asarray(window_idx, dtype=np.int64)
+        lens = np.asarray(lens, dtype=np.int64)
+        mapped = remap[window_idx]
+        keep = mapped >= 0
+        row_of = np.repeat(np.arange(lens.size, dtype=np.int64), lens)
+        new_lens = np.bincount(
+            row_of[keep], minlength=lens.size
+        ).astype(np.int64)
+        return mapped[keep], new_lens
 
     def _blocks_to_gramian(self, blocks, g_init=None, prepacked=False):
-        n = self.index.size
+        n = self.cohort.size
         depth = getattr(self.conf, "prefetch_depth", 2)
         if self._mesh_spans_processes():
             # Pod mode: the mesh covers every process; each host feeds its
@@ -647,7 +737,9 @@ class VariantsPcaDriver:
         reference's N²-entry shuffle (VariantsPca.scala:190).
         """
         blocks = blocks_from_calls(
-            calls, self.index.size, self.conf.block_variants
+            self._restrict_calls(calls),
+            self.cohort.size,
+            self.conf.block_variants,
         )
         return self._gramian_from_block_stream(blocks)
 
@@ -666,6 +758,7 @@ class VariantsPcaDriver:
         accumulation, pinned by test). Mesh layouts keep the int8 block
         stream (their accumulators pad the sample axis before packing).
         """
+        csr_pairs = self._restrict_csr(csr_pairs)
         if self.mesh is None:
             from spark_examples_tpu.arrays.blocks import (
                 packed_blocks_from_csr,
@@ -673,7 +766,7 @@ class VariantsPcaDriver:
 
             blocks = packed_blocks_from_csr(
                 csr_pairs,
-                self.index.size,
+                self.cohort.size,
                 self.conf.block_variants,
                 workers=self._block_builder_workers(),
                 attempt=self._build_attempt,
@@ -682,7 +775,7 @@ class VariantsPcaDriver:
         from spark_examples_tpu.arrays.blocks import blocks_from_csr
 
         blocks = blocks_from_csr(
-            csr_pairs, self.index.size, self.conf.block_variants
+            csr_pairs, self.cohort.size, self.conf.block_variants
         )
         return self._gramian_from_block_stream(blocks)
 
@@ -756,7 +849,7 @@ class VariantsPcaDriver:
         window-sized transient on top (NOTES.md verdict #7's 16·N² host
         peak — int64 host G + f32 copy + jax buffer — is gone: the
         sparse engine never accumulates on the host)."""
-        n = self.index.size
+        n = self.cohort.size
         itemsize = 4  # f32 accumulator, exact below 2^24 counts
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -819,7 +912,7 @@ class VariantsPcaDriver:
 
                 g = sparse_sharded_gramian_blockwise(
                     cancellable(),
-                    self.index.size,
+                    self.cohort.size,
                     self.mesh,
                     density_threshold=self.conf.sparse_density_threshold,
                     block_variants=self.conf.block_variants,
@@ -848,7 +941,7 @@ class VariantsPcaDriver:
 
             g = sparse_gramian_blockwise(
                 cancellable(),
-                self.index.size,
+                self.cohort.size,
                 density_threshold=self.conf.sparse_density_threshold,
                 block_variants=self.conf.block_variants,
             )
@@ -860,38 +953,105 @@ class VariantsPcaDriver:
                 g = allreduce_gramian(g)
             return g
 
-    def _gramian_sparse(self):
-        """Sparse-aware ingest: route the best available tier's output
-        as CSR carrier windows (never densified blocks) into
-        :meth:`_windows_to_gramian`. The CSR sidecar tier feeds windows
-        straight from ``(indices, offsets)`` pairs; call-list tiers go
-        through ``windows_from_calls`` — same window composition as the
-        dense path's block composition, so sparse-vs-dense G bit-identity
-        is comparable window for window."""
+    def _cohort_windows(self, restrict: bool = True):
+        """Route the best available tier's output as CSR carrier
+        windows (never densified blocks). The CSR sidecar tier feeds
+        windows straight from ``(indices, offsets)`` pairs; call-list
+        tiers go through ``windows_from_calls`` — same window
+        composition as the dense path's block composition, so
+        sparse-vs-dense G bit-identity is comparable window for window.
+        ``restrict=False`` yields FULL-frame windows regardless of any
+        cohort sample restriction — the delta/gang serving paths build
+        per-cohort views from one shared full-frame stream."""
         from spark_examples_tpu.arrays.blocks import (
             csr_windows,
             windows_from_calls,
         )
 
         if self._fused_csr_possible():
-            windows = csr_windows(
-                self.get_csr_fused(), self.conf.block_variants
-            )
-        elif self._fused_ingest_possible():
-            windows = windows_from_calls(
-                self.get_calls_fused(), self.conf.block_variants
-            )
+            pairs = self.get_csr_fused()
+            if restrict:
+                pairs = self._restrict_csr(pairs)
+            return csr_windows(pairs, self.conf.block_variants)
+        if self._fused_ingest_possible():
+            calls = self.get_calls_fused()
         elif self._fused_multi_possible():
-            windows = windows_from_calls(
-                self.get_calls_fused_multi(), self.conf.block_variants
-            )
+            calls = self.get_calls_fused_multi()
         else:
             data = self.get_data()
             filtered = [self.filter_dataset(d) for d in data]
-            windows = windows_from_calls(
-                self.get_calls(filtered), self.conf.block_variants
+            calls = self.get_calls(filtered)
+        if restrict:
+            calls = self._restrict_calls(calls)
+        return windows_from_calls(calls, self.conf.block_variants)
+
+    def _gramian_sparse(self):
+        """Sparse-aware ingest: cohort-frame CSR carrier windows into
+        :meth:`_windows_to_gramian`."""
+        return self._windows_to_gramian(self._cohort_windows())
+
+    # -- serving entry points: window capture, deltas ------------------------
+
+    def ingest_gramian_windows(self, window_sink=None):
+        """Meshless window-route ingest for the delta-aware serving
+        engine: same finished G as :meth:`ingest_gramian` (integer-exact
+        accumulation — bit-identical across routes, pinned by tests),
+        but fed through the sparse engine's window stream so the
+        FULL-frame windows can be captured into ``window_sink`` on the
+        way (the delta index's per-base-key window cache) while the
+        cohort-restricted view accumulates. Checkpointed and mesh runs
+        must keep :meth:`ingest_gramian` (no capture there)."""
+        if self.conf.checkpoint_dir or self.mesh is not None:
+            raise ValueError(
+                "ingest_gramian_windows serves meshless uncheckpointed "
+                "runs; use ingest_gramian"
             )
-        return self._windows_to_gramian(windows)
+
+        def stream():
+            for window in self._cohort_windows(restrict=False):
+                if window_sink is not None:
+                    window_sink.append(window)
+                yield self._restrict_window(window)
+
+        return self._windows_to_gramian(stream())
+
+    def ingest_gramian_delta(
+        self, cached_g, cached_samples, windows=None, window_sink=None
+    ):
+        """Target-cohort G from a cached ancestor G by exact rank-k
+        sample correction (:mod:`spark_examples_tpu.ops.delta`) —
+        bit-identical to from-scratch, O(k·N) device work instead of a
+        full re-accumulation.
+
+        ``cached_samples`` is the ancestor's callset-id frame (row i of
+        ``cached_g`` is that callset). ``windows`` is the base key's
+        cached full-frame window list; None re-streams the source (and
+        captures into ``window_sink`` so the next delta is
+        ingest-free). Returns a host f32 array in this driver's cohort
+        frame.
+        """
+        from spark_examples_tpu.ops.delta import delta_gramian
+
+        full = self.index.indexes
+        ancestor = np.asarray(
+            [full[cid] for cid in cached_samples], dtype=np.int64
+        )
+        target = np.asarray(
+            [full[cid] for cid in self.cohort.callset_of_index()],
+            dtype=np.int64,
+        )
+        if windows is None:
+
+            def stream():
+                for window in self._cohort_windows(restrict=False):
+                    if window_sink is not None:
+                        window_sink.append(window)
+                    yield window
+
+            windows = stream()
+        return delta_gramian(
+            cached_g, ancestor, target, self.index.size, windows
+        )
 
     def get_similarity_matrix_stream(
         self, calls: Iterable[List[int]], max_host_bytes: int = 4 << 30
@@ -918,7 +1078,7 @@ class VariantsPcaDriver:
         """
         from spark_examples_tpu.arrays.blocks import windows_from_calls
 
-        n = self.index.size
+        n = self.cohort.size
         need = self._sparse_host_g_bytes()
         if need > max_host_bytes:
             layout = (
@@ -937,7 +1097,9 @@ class VariantsPcaDriver:
                 "this host has the memory"
             )
         return self._windows_to_gramian(
-            windows_from_calls(calls, self.conf.block_variants)
+            windows_from_calls(
+                self._restrict_calls(calls), self.conf.block_variants
+            )
         )
 
     def get_similarity_matrix_checkpointed(self):
@@ -1556,7 +1718,7 @@ class VariantsPcaDriver:
             return False
         if mode == "fused":
             return True
-        return self.index.size <= self.conf.dense_eigh_limit
+        return self.cohort.size <= self.conf.dense_eigh_limit
 
     def _compute_pca(self, g, timer=None) -> List[Tuple[str, float, float]]:
         import jax.numpy as jnp
@@ -1608,7 +1770,7 @@ class VariantsPcaDriver:
                 nonzero = int((np.asarray(row_sums) > 0).sum())
                 print(
                     f"Non zero rows in matrix: {nonzero} / "
-                    f"{self.index.size}."
+                    f"{self.cohort.size}."
                 )
                 return self._emit_tuples(coords)
 
@@ -1632,7 +1794,7 @@ class VariantsPcaDriver:
             )
         nonzero = int((row_sums > 0).sum())
         print(
-            f"Non zero rows in matrix: {nonzero} / {self.index.size}."
+            f"Non zero rows in matrix: {nonzero} / {self.cohort.size}."
         )  # VariantsPca.scala:207-208
         if self.conf.precise:
             # Host-f64 LAPACK path: implies N is gatherable (the reference
@@ -1652,7 +1814,7 @@ class VariantsPcaDriver:
             coords, _ = topk_with_gap_check(
                 lambda kk: mllib_principal_components_reference(gh, kk),
                 self.conf.num_pc,
-                self.index.size,
+                self.cohort.size,
                 timer=timer,
                 vals_are_squared=True,  # covariance eigenvalues = λ(C)²/(n−1)
             )
@@ -1675,20 +1837,20 @@ class VariantsPcaDriver:
             coords, _ = topk_with_gap_check(
                 lambda kk: pcoa(g, kk),
                 self.conf.num_pc,
-                self.index.size,
+                self.cohort.size,
                 timer=timer,
             )
         return self._emit_tuples(coords)
 
     def _emit_tuples(self, coords) -> List[Tuple[str, float, float]]:
         coords = np.asarray(coords)
-        callset_ids = self.index.callset_of_index()
+        callset_ids = self.cohort.callset_of_index()
         # The reference emits exactly two components regardless of --num-pc
         # (VariantsPca.scala:228-230: array(i), array(i + numRows)).
         pc2 = coords[:, 1] if coords.shape[1] > 1 else np.zeros(len(coords))
         return [
             (callset_ids[i], float(coords[i, 0]), float(pc2[i]))
-            for i in range(self.index.size)
+            for i in range(self.cohort.size)
         ]
 
     # -- stage 6: emission ---------------------------------------------------
@@ -1702,7 +1864,7 @@ class VariantsPcaDriver:
         clients, and the one place the name/dataset join lives."""
         return sorted(
             (
-                self.index.names[cid],
+                self.cohort.names[cid],
                 pc1,
                 pc2,
                 cid.split("-")[0],  # dataset label, VariantsPca.scala:235

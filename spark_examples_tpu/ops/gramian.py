@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = [
+    "gang_gramian_blockwise",
     "gramian",
     "gramian_accumulate",
     "gramian_accumulate_packed",
@@ -233,6 +234,72 @@ def gramian_accumulate_packed(g, x_packed, n_bits=None, compute_dtype=None):
         jnp.int8, g.dtype, compute_dtype
     )
     return _gramian_accumulate_packed_jit(g, x_packed, n_bits, compute_dtype)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("compute_dtype",),
+    donate_argnums=(0,),
+)
+def _gang_accumulate_jit(g, x_stack, compute_dtype):
+    """One gang step: ``G[b] += X[b] @ X[b].T`` for every cohort b —
+    the per-cohort Gramian step vmapped over the leading batch axis, so
+    B small-cohort accumulations ride ONE dispatch and one executable
+    (the MXU analogue of request coalescing)."""
+    return g + jax.vmap(
+        lambda xb: mxu_cross_product(xb, g.dtype, compute_dtype)
+    )(x_stack)
+
+
+def gang_gramian_blockwise(
+    windows: Iterable,
+    remaps,
+    n_max: int,
+    block_variants: int = 8192,
+    accum_dtype=jnp.float32,
+    compute_dtype=None,
+):
+    """Batched Gramians for B cohorts from ONE full-frame window stream.
+
+    ``windows`` yields full-frame ``(indices, lens)`` CSR windows (the
+    ``csr_windows``/``windows_from_calls`` shape); ``remaps`` is one
+    int array per cohort mapping full-frame sample index → that
+    cohort's dense index (< 0 drops the carrier). Every window is
+    scattered into one ``(B, n_max, width)`` int8 stack (cohorts
+    shorter than ``n_max`` zero-pad — inert rows) and accumulated by
+    the vmapped batch step: ONE jit cache entry for the whole gang,
+    device round-trips amortized B-fold. Each ``G[b]``'s top-left
+    ``(n_b, n_b)`` corner is bit-identical to that cohort's serial
+    accumulation — exact integer counts, any composition (pinned by
+    tests).
+
+    Returns the host ``(B, n_max, n_max)`` f32 stack (callers slice
+    per-cohort corners).
+    """
+    batch = len(remaps)
+    if batch == 0:
+        raise ValueError("gang_gramian_blockwise needs >= 1 cohort")
+    remaps = [np.asarray(r, dtype=np.int64) for r in remaps]
+    g = jnp.zeros((batch, n_max, n_max), dtype=accum_dtype)
+    compute_dtype = resolve_gramian_compute_dtype(
+        jnp.int8, accum_dtype, compute_dtype
+    )
+    for window_idx, lens in windows:
+        window_idx = np.asarray(window_idx, dtype=np.int64)
+        lens = np.asarray(lens, dtype=np.int64)
+        # Fixed width = the block width: every full-size window hits
+        # the same executable; only the tail window pays a second one.
+        width = max(int(lens.size), 1)
+        if width < block_variants:
+            width = block_variants
+        cols = np.repeat(np.arange(lens.size, dtype=np.int64), lens)
+        stack = np.zeros((batch, n_max, width), dtype=np.int8)
+        for b, remap in enumerate(remaps):
+            mapped = remap[window_idx]
+            keep = mapped >= 0
+            stack[b][mapped[keep], cols[keep]] = 1
+        g = _gang_accumulate_jit(g, stack, compute_dtype)
+    return np.asarray(g)
 
 
 def gramian_blockwise(

@@ -16,6 +16,10 @@ imports the driver lazily), so a host-only ``serve-cohort`` without
 ``--analyze`` never pays the jax import.
 """
 
+from spark_examples_tpu.serving.deltas import (
+    DeltaIndex,
+    gramian_base_key,
+)
 from spark_examples_tpu.serving.engine import AnalysisEngine
 from spark_examples_tpu.serving.jobs import (
     Job,
@@ -38,6 +42,7 @@ __all__ = [
     "AdmissionQueue",
     "AnalysisEngine",
     "AnalysisJobTier",
+    "DeltaIndex",
     "Job",
     "JobJournal",
     "JobSpec",
@@ -46,5 +51,6 @@ __all__ = [
     "QuotaExceededError",
     "SimulatedCrash",
     "cohort_key",
+    "gramian_base_key",
     "job_config",
 ]

@@ -1,0 +1,264 @@
+"""Delta index over the cohort-hash cache: nearest-ancestor Gramians.
+
+The serving tier's result cache (``serving/tier.py``) is keyed on the
+murmur3 cohort hash of the fully-resolved analysis parameters — exact
+matches only. This module adds the INCREMENTAL layer underneath it: a
+per-server index of finished Gramians keyed by the **base key** (the
+resolved parameters that determine G's VALUES — variant sets,
+references, AF filter — excluding the sample set, which determines G's
+FRAME, and ``num_pc``, which only shapes the finish), each entry
+carrying the cohort's sample frame and an integrity checksum. A new
+submission resolves to its nearest cached ancestor — same base key,
+sample set differing by at most ``delta_max_samples`` — and the engine
+updates that G with exact rank-k corrections (:mod:`ops.delta`) instead
+of re-accumulating from scratch.
+
+Safety posture: deltas are an OPTIMIZATION and must never be able to
+change results. Every cached G carries a murmur3 checksum taken at
+insert; resolution re-verifies it, and any mismatch (or any error while
+applying a correction) falls back to the cold path — counted as
+``serving_delta_jobs_total{outcome="fallback"}`` so operators see decay
+instead of silently losing the win. The delta math itself is
+integer-exact, so a served delta is bit-identical to from-scratch
+(pinned by tests); the checksum guards the CACHE, not the math.
+
+The index also caches the base key's full-frame CSR **windows** (the
+ingest stream's ``(indices, lens)`` pairs) when a cold run captured
+them, so corrections are built from in-memory arrays — the O(k·N)
+touch-up never re-pays the host ingest. Both stores are LRU-bounded by
+bytes; jax-free at import time like the rest of ``serving/``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "DEFAULT_DELTA_MAX_SAMPLES",
+    "DEFAULT_GANG_MAX_SAMPLES",
+    "DeltaEntry",
+    "DeltaIndex",
+    "gramian_base_key",
+    "gramian_checksum",
+    "note_delta",
+]
+
+# Largest sample-set symmetric difference the ancestor resolution will
+# bridge (|added| + |removed|): beyond it a from-scratch run is cheaper
+# than the correction. 0 disables the delta tier entirely.
+DEFAULT_DELTA_MAX_SAMPLES = 16
+
+# Cohorts at or below this many samples are gang-batching candidates
+# (serving/tier.py): small-N jobs are dispatch-bound, exactly where
+# stacking them along a batch axis amortizes device round-trips.
+DEFAULT_GANG_MAX_SAMPLES = 256
+
+# LRU byte budgets for the cached Gramians and the per-base-key window
+# sets. Internal constants, not flags: they bound SERVER memory, and the
+# correct values follow from host RAM, not workload tuning.
+_GRAMIAN_CACHE_BYTES = 256 << 20
+_WINDOW_CACHE_BYTES = 128 << 20
+# A single G bigger than this fraction of the budget is not worth
+# caching (it would evict everything else for one unlikely ancestor).
+_MAX_ENTRY_FRACTION = 4
+
+
+def gramian_base_key(conf: Any) -> str:
+    """Hex murmur3 key over the resolved parameters that determine G's
+    values — variant sets, references window, AF filter. The sample
+    restriction (``samples``/``exclude_samples``) is EXCLUDED on
+    purpose: cohorts differing only in samples share a base key, which
+    is what makes one cohort's G another cohort's ancestor. ``num_pc``
+    is excluded too — it shapes the eigensolve, never G."""
+    from spark_examples_tpu.genomics.hashing import murmur3_x64_128
+
+    payload = json.dumps(
+        {
+            "variant_set_ids": list(conf.variant_set_ids),
+            "references": conf.references,
+            "all_references": bool(conf.all_references),
+            "min_allele_frequency": conf.min_allele_frequency,
+        },
+        sort_keys=True,
+        separators=(",", ":"),
+    ).encode("utf-8")
+    return murmur3_x64_128(payload).hex()
+
+
+def gramian_checksum(g: np.ndarray) -> str:
+    """Integrity digest of a cached Gramian (murmur3 over the f32
+    bytes) — taken at insert, re-verified at resolve."""
+    from spark_examples_tpu.genomics.hashing import murmur3_x64_128
+
+    return murmur3_x64_128(
+        np.ascontiguousarray(g, dtype=np.float32).tobytes()
+    ).hex()
+
+
+def note_delta(outcome: str) -> None:
+    """Count one delta resolution outcome: ``hit`` (ancestor found and
+    applied), ``fallback`` (checksum mismatch or correction error →
+    cold), ``miss`` (no ancestor within range → cold)."""
+    from spark_examples_tpu import obs
+
+    obs.get_registry().counter(
+        "serving_delta_jobs_total",
+        "Delta-index resolutions for analysis jobs (hit = served by "
+        "rank-k correction; fallback = guard tripped, ran cold; miss = "
+        "no cached ancestor)",
+    ).labels(outcome=outcome).inc()
+
+
+class DeltaEntry:
+    """One cached Gramian: base key + sample frame + f32 G + checksum.
+
+    ``g`` is treated as IMMUTABLE once inserted — resolution hands the
+    same array to every delta job, and the correction math never writes
+    into it (``ops.delta`` gathers from it into a fresh target array).
+    """
+
+    __slots__ = ("base_key", "samples", "g", "checksum")
+
+    def __init__(
+        self, base_key: str, samples: Tuple[str, ...], g: np.ndarray
+    ) -> None:
+        self.base_key = base_key
+        self.samples = samples
+        # A PRIVATE copy, never a view: np.asarray over a jax array is
+        # a zero-copy read-only view of the device buffer on CPU, and a
+        # later donating dispatch could reuse that buffer — the
+        # checksum guard would catch the corruption, but the cache
+        # entry would be lost. Copying makes the entry self-owned.
+        self.g = np.array(g, dtype=np.float32, order="C", copy=True)
+        self.checksum = gramian_checksum(self.g)
+
+    def verify(self) -> bool:
+        """True when the cached bytes still match the insert-time
+        checksum — the fall-back-to-cold guard."""
+        return gramian_checksum(self.g) == self.checksum
+
+
+class DeltaIndex:
+    """Thread-safe nearest-ancestor index of cached Gramians + the
+    per-base-key full-frame window cache (both byte-bounded LRU)."""
+
+    def __init__(
+        self,
+        max_delta_samples: int = DEFAULT_DELTA_MAX_SAMPLES,
+        max_bytes: int = _GRAMIAN_CACHE_BYTES,
+        max_window_bytes: int = _WINDOW_CACHE_BYTES,
+    ) -> None:
+        self.max_delta_samples = max(0, max_delta_samples)
+        self.max_bytes = max(1, max_bytes)
+        self.max_window_bytes = max(1, max_window_bytes)
+        self._lock = threading.Lock()
+        # (base_key, samples) -> entry, LRU over total G bytes.
+        self._entries: "collections.OrderedDict[Tuple[str, Tuple[str, ...]], DeltaEntry]" = (
+            collections.OrderedDict()
+        )
+        self._bytes = 0
+        # base_key -> list of (indices, lens) full-frame windows.
+        self._windows: "collections.OrderedDict[str, List[Tuple[np.ndarray, np.ndarray]]]" = (
+            collections.OrderedDict()
+        )
+        self._window_bytes: Dict[str, int] = {}
+
+    # -- Gramian entries ------------------------------------------------------
+
+    def resolve(
+        self, base_key: str, samples: Sequence[str]
+    ) -> Optional[DeltaEntry]:
+        """Nearest cached ancestor: same base key, sample-set symmetric
+        difference ≤ ``max_delta_samples`` (0 = exact frame, the
+        num_pc-tweak case). Ties prefer the smallest difference, then
+        the most recently used. Returns None when nothing qualifies."""
+        want = set(samples)
+        with self._lock:
+            best: Optional[DeltaEntry] = None
+            best_d = self.max_delta_samples + 1
+            # Most-recently-used last; iterate reversed so recency
+            # breaks ties at equal distance.
+            for (key, _), entry in reversed(self._entries.items()):
+                if key != base_key:
+                    continue
+                d = len(want.symmetric_difference(entry.samples))
+                if d < best_d:
+                    best, best_d = entry, d
+                    if d == 0:
+                        break
+            if best is not None:
+                self._entries.move_to_end((base_key, best.samples))
+            return best
+
+    def put(
+        self, base_key: str, samples: Sequence[str], g: np.ndarray
+    ) -> None:
+        """Insert/refresh one finished Gramian (no-op when a single G
+        would consume more than its budget share)."""
+        entry = DeltaEntry(base_key, tuple(samples), g)
+        if entry.g.nbytes > self.max_bytes // _MAX_ENTRY_FRACTION:
+            return
+        key = (base_key, entry.samples)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.g.nbytes
+            self._entries[key] = entry
+            self._bytes += entry.g.nbytes
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= evicted.g.nbytes
+
+    def drop(self, entry: DeltaEntry) -> None:
+        """Remove a corrupt entry (checksum guard tripped)."""
+        key = (entry.base_key, entry.samples)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.g.nbytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- full-frame window cache ----------------------------------------------
+
+    def windows(
+        self, base_key: str
+    ) -> Optional[List[Tuple[np.ndarray, np.ndarray]]]:
+        """The base key's captured full-frame CSR windows (None when no
+        cold run captured them yet). The returned list and its arrays
+        are shared and must be treated as read-only."""
+        with self._lock:
+            wins = self._windows.get(base_key)
+            if wins is not None:
+                self._windows.move_to_end(base_key)
+            return wins
+
+    def put_windows(
+        self,
+        base_key: str,
+        windows: Sequence[Tuple[np.ndarray, np.ndarray]],
+    ) -> None:
+        nbytes = int(
+            sum(int(i.nbytes) + int(l.nbytes) for i, l in windows)
+        )
+        if nbytes > self.max_window_bytes // _MAX_ENTRY_FRACTION:
+            return
+        with self._lock:
+            if base_key in self._windows:
+                self._windows.move_to_end(base_key)
+                return  # same base key => same stream; keep the first
+            self._windows[base_key] = list(windows)
+            self._window_bytes[base_key] = nbytes
+            while (
+                sum(self._window_bytes.values()) > self.max_window_bytes
+                and len(self._windows) > 1
+            ):
+                evicted_key, _ = self._windows.popitem(last=False)
+                self._window_bytes.pop(evicted_key, None)

@@ -63,8 +63,29 @@ _SPEC_FIELDS = frozenset(
         "min_allele_frequency",
         "num_pc",
         "priority",
+        "samples",
+        "exclude_samples",
     }
 )
+
+
+def _sample_list(
+    rec: Dict[str, Any], field: str
+) -> Optional[Tuple[str, ...]]:
+    """Validate + canonicalize a cohort sample-restriction field: a
+    list of callset-id strings, sorted and deduplicated so permuted
+    submissions are ONE cohort (one cache key, one frame — the frame
+    itself orders by full-index position, driver-side)."""
+    val = rec.get(field)
+    if val is None:
+        return None
+    if not isinstance(val, (list, tuple)) or not all(
+        isinstance(s, str) and s for s in val
+    ):
+        raise ValueError(
+            f"{field} must be a list of non-empty callset-id strings"
+        )
+    return tuple(sorted(set(val)))
 
 
 @dataclass(frozen=True)
@@ -81,6 +102,11 @@ class JobSpec:
     min_allele_frequency: Optional[float] = None
     num_pc: Optional[int] = None
     priority: int = 0
+    # Cohort sample restriction: `samples` keeps only the named
+    # callset ids (None = all), `exclude_samples` then drops ids —
+    # the spec surface the delta tier's ±k cohort queries ride.
+    samples: Optional[Tuple[str, ...]] = None
+    exclude_samples: Optional[Tuple[str, ...]] = None
 
     @classmethod
     def from_record(cls, rec: Dict[str, Any]) -> "JobSpec":
@@ -134,10 +160,12 @@ class JobSpec:
             min_allele_frequency=af,
             num_pc=num_pc,
             priority=priority,
+            samples=_sample_list(rec, "samples"),
+            exclude_samples=_sample_list(rec, "exclude_samples"),
         )
 
     def to_record(self) -> Dict[str, Any]:
-        return {
+        rec: Dict[str, Any] = {
             "tenant": self.tenant,
             "variant_set_ids": list(self.variant_set_ids),
             "references": self.references,
@@ -146,6 +174,14 @@ class JobSpec:
             "num_pc": self.num_pc,
             "priority": self.priority,
         }
+        # Omitted when unset: journals written before the sample-
+        # restriction fields existed replay unchanged, and unrestricted
+        # specs keep their historical record shape.
+        if self.samples is not None:
+            rec["samples"] = list(self.samples)
+        if self.exclude_samples is not None:
+            rec["exclude_samples"] = list(self.exclude_samples)
+        return rec
 
 
 def resolve_spec(spec: JobSpec, base: Any) -> Dict[str, Any]:
@@ -174,7 +210,24 @@ def resolve_spec(spec: JobSpec, base: Any) -> Dict[str, Any]:
         "num_pc": (
             spec.num_pc if spec.num_pc is not None else base.num_pc
         ),
+        "samples": _resolved_samples(spec.samples, base, "samples"),
+        "exclude_samples": _resolved_samples(
+            spec.exclude_samples, base, "exclude_samples"
+        ),
     }
+
+
+def _resolved_samples(
+    spec_val: Optional[Tuple[str, ...]], base: Any, field: str
+) -> Optional[List[str]]:
+    """Spec value wins; otherwise the server default, canonicalized the
+    same way (sorted, deduplicated) so key equality is frame equality."""
+    if spec_val is not None:
+        return list(spec_val)
+    base_val = getattr(base, field, None)
+    if not base_val:
+        return None
+    return sorted(set(base_val))
 
 
 def cohort_key(spec: JobSpec, base: Any) -> str:
@@ -206,6 +259,8 @@ def job_config(
         all_references=resolved["all_references"],
         min_allele_frequency=resolved["min_allele_frequency"],
         num_pc=resolved["num_pc"],
+        samples=resolved["samples"],
+        exclude_samples=resolved["exclude_samples"],
         checkpoint_dir=checkpoint_dir,
         elastic_checkpoint=False,
         output_path=None,

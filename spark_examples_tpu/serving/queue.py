@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import heapq
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from spark_examples_tpu.resilience.policy import RetryPolicy
 from spark_examples_tpu.utils.lockcheck import assert_lock_held
@@ -196,6 +196,36 @@ class AdmissionQueue:
             _, _, job = heapq.heappop(self._heap)
             self._note_depth_locked()
             return job
+
+    def take_compatible(
+        self, pred: Callable[[object], bool], limit: int
+    ) -> List[object]:
+        """Pop up to ``limit`` queued jobs satisfying ``pred`` — the
+        gang-batching selector: a worker that just popped a lead job
+        collects the compatible queued jobs (same resolved variant
+        params, small enough cohorts) to run as ONE batched dispatch.
+        Selection follows pop order (priority desc, seq asc), so a gang
+        is exactly the prefix of jobs a serial worker would have run
+        next. Tenant in-flight slots are NOT released — the jobs are
+        still in flight, exactly as if a worker had popped each one.
+        ``pred`` runs under the queue lock and must not block or
+        acquire the tier lock (lock hierarchy: tier → queue).
+        """
+        with self._cv:
+            if limit <= 0 or not self._heap:
+                return []
+            taken: List[object] = []
+            kept: List[Tuple[int, int, object]] = []
+            for entry in sorted(self._heap):
+                if len(taken) < limit and pred(entry[2]):
+                    taken.append(entry[2])
+                else:
+                    kept.append(entry)
+            if taken:
+                self._heap = kept
+                heapq.heapify(self._heap)
+                self._note_depth_locked()
+            return taken
 
     def _release_tenant_locked(self, tenant: str) -> None:
         assert_lock_held(

@@ -50,6 +50,7 @@ from spark_examples_tpu.serving.jobs import (
     JobSpec,
     cohort_key,
     job_config,
+    resolve_spec,
 )
 from spark_examples_tpu.serving.queue import (
     AdmissionQueue,
@@ -63,9 +64,15 @@ __all__ = [
     "SimulatedCrash",
     "DEFAULT_RESULT_CACHE",
     "DEFAULT_JOB_RETENTION",
+    "GANG_MAX_JOBS",
 ]
 
 DEFAULT_RESULT_CACHE = 256
+
+# Most queued compatible jobs one gang coalesces (the lead + this-1
+# members): bounds the batched stack's host/device footprint at
+# GANG_MAX_JOBS × gang_max_samples × block_variants int8 bytes.
+GANG_MAX_JOBS = 16
 
 # Terminal (done/failed) jobs kept queryable in memory: beyond this the
 # oldest are evicted (their results live on in the LRU cache / journal).
@@ -136,10 +143,15 @@ class AnalysisJobTier:
         cache_size: int = DEFAULT_RESULT_CACHE,
         breakers: Any = None,
         job_retention: int = DEFAULT_JOB_RETENTION,
+        gang_max_samples: int = 0,
     ) -> None:
         from spark_examples_tpu.resilience import BreakerSet
 
         self._engine = engine
+        # Gang batching: cohorts at or below this many samples coalesce
+        # with compatible queued jobs into one batched dispatch
+        # (0 = disabled — the historical one-job-per-dispatch tier).
+        self._gang_max = max(0, gang_max_samples)
         self._base = base_config
         self._queue = AdmissionQueue(queue_depth, tenant_quota)
         self._cache = _ResultCache(cache_size)
@@ -354,17 +366,152 @@ class AnalysisJobTier:
     # -- execution ------------------------------------------------------------
 
     def step(self, timeout: float = 0.0) -> bool:
-        """Run one queued job on the caller's thread (the worker body,
-        exposed for deterministic tests and ``workers=0`` tiers).
-        Returns False when nothing runnable was queued."""
+        """Run one queued job — or one coalesced GANG — on the caller's
+        thread (the worker body, exposed for deterministic tests and
+        ``workers=0`` tiers). Returns False when nothing runnable was
+        queued."""
         while True:
             job = self._queue.pop(timeout=timeout)
             if job is None:
                 return False
             if job.state != JOB_QUEUED:
                 continue  # a rolled-back admission's stale heap entry
-            self._execute(job)
+            self._dispatch(job)
             return True
+
+    def _dispatch(self, job: Job) -> None:
+        """One popped lead job → solo execution or a coalesced gang."""
+        gang = self._gang_for(job)
+        if gang:
+            self._execute_gang([job] + gang)
+        else:
+            self._execute(job)
+
+    def _gang_for(self, lead: Job) -> List[Job]:
+        """Compatible queued jobs to batch with ``lead`` (possibly
+        empty): same Gramian base key (resolved variant params — one
+        shared window stream), every cohort at most ``gang_max_samples``
+        samples. A lead the delta index can answer runs solo — the
+        rank-k touch-up beats riding a cold gang."""
+        if self._gang_max <= 0:
+            return []
+        engine = self._engine
+        if (
+            getattr(engine, "run_gang", None) is None
+            or getattr(engine, "mesh", None) is not None
+        ):
+            return []
+        try:
+            lead_conf = job_config(lead.spec, self._base)
+            lead_key = engine.gang_key(lead_conf)
+            # Resolved HERE, outside the queue lock: an index_for LRU
+            # miss runs source I/O, and the predicate below runs under
+            # AdmissionQueue._cv. Members share the lead's index —
+            # equal base keys mean equal variantset tuples.
+            lead_index = engine.index_for(
+                tuple(lead_conf.variant_set_ids)
+            )
+            if engine.cohort_size(lead_conf, lead_index) > self._gang_max:
+                return []
+            if engine.delta_resolvable(lead_conf):
+                return []
+        except Exception:  # noqa: BLE001 — probe failure = no gang
+            return []
+
+        def compatible(other: Any) -> bool:
+            if other.state != JOB_QUEUED:
+                return False  # a rolled-back admission's stale entry
+            try:
+                conf = job_config(other.spec, self._base)
+                return (
+                    engine.gang_key(conf) == lead_key
+                    and engine.cohort_size(conf, lead_index)
+                    <= self._gang_max
+                )
+            except Exception:  # noqa: BLE001 — bad spec: solo fails it
+                return False
+
+        return self._queue.take_compatible(
+            compatible, GANG_MAX_JOBS - 1
+        )
+
+    def _note_gang(self, size: int) -> None:
+        from spark_examples_tpu import obs
+
+        obs.get_registry().histogram(
+            "serving_gang_size",
+            "Jobs coalesced per gang-batched Gramian dispatch",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0),
+        ).observe(float(size))
+
+    def _execute_gang(self, jobs: List[Job]) -> None:
+        """Run a coalesced gang: per-job journal transitions exactly as
+        solo execution writes them (crash-safe replay semantics are
+        UNCHANGED — a kill mid-gang re-queues every started member and
+        re-execution is bit-identical whatever gang it lands in), one
+        batched engine dispatch, per-job finishes."""
+        from spark_examples_tpu import obs
+        from spark_examples_tpu.resilience import faults
+
+        live: List[Job] = []
+        with self._lock:
+            for job in jobs:
+                if job.state != JOB_QUEUED:
+                    continue
+                job.state = JOB_RUNNING
+                live.append(job)
+        # Disk I/O outside the tier lock (submit() reasoning).
+        for job in live:
+            self._journal_append_safe({"e": "start", "id": job.id})
+            obs.instant(
+                "job_transition", scope="p", id=job.id, to=JOB_RUNNING
+            )
+        for job in live:
+            try:
+                faults.inject("serving.job.kill", key=job.id)
+            except faults.InjectedFault as e:
+                # As in _execute: journal left exactly as a SIGKILL
+                # would leave it — every started member re-queues on
+                # replay.
+                raise SimulatedCrash(str(e)) from e
+        runnable: List[Job] = []
+        for job in live:
+            try:
+                faults.inject("serving.job.run", key=job.id)
+            except Exception as e:  # noqa: BLE001 — member isolation
+                self._finish(job, error=f"{type(e).__name__}: {e}")
+                if isinstance(e, IOError):
+                    self._breaker.record_failure()
+                else:
+                    self._breaker.record_success()
+            else:
+                runnable.append(job)
+        if not runnable:
+            return
+        self._note_gang(len(runnable))
+        try:
+            with obs.span(
+                "job.gang",
+                size=len(runnable),
+                jobs=",".join(j.id for j in runnable),
+            ):
+                rows_by_job = self._engine.run_gang(
+                    [
+                        job_config(j.spec, self._base)
+                        for j in runnable
+                    ]
+                )
+        except Exception as e:  # noqa: BLE001 — gang isolation boundary
+            for job in runnable:
+                self._finish(job, error=f"{type(e).__name__}: {e}")
+                if isinstance(e, IOError):
+                    self._breaker.record_failure()
+                else:
+                    self._breaker.record_success()
+        else:
+            for job, rows in zip(runnable, rows_by_job):
+                self._finish(job, rows=rows)
+                self._breaker.record_success()
 
     def _worker_loop(self) -> None:
         while not self._stop.is_set():
@@ -372,7 +519,7 @@ class AnalysisJobTier:
             if job is None:
                 continue
             try:
-                self._execute(job)
+                self._dispatch(job)
             except SimulatedCrash as e:
                 print(
                     f"analysis worker crashed (simulated kill): {e}",
@@ -400,6 +547,12 @@ class AnalysisJobTier:
             self._base.variant_set_ids
         )
         if self._journal_dir is None or len(spec_vsids) != 1:
+            return None
+        resolved = resolve_spec(job.spec, self._base)
+        if resolved["samples"] or resolved["exclude_samples"]:
+            # Sample-restricted cohorts don't compose with checkpointed
+            # ingest (snapshot digests are full-frame); these jobs are
+            # the small delta-tier queries — replay just re-runs them.
             return None
         return os.path.join(self._journal_dir, "ckpt", job.id)
 

@@ -37,6 +37,12 @@ __all__ = [
     "add_pca_flags",
 ]
 
+def _csv_list(value: str) -> List[str]:
+    """argparse type for comma-separated id lists (empty items dropped,
+    so a trailing comma is not a silent empty id)."""
+    return [item for item in value.split(",") if item]
+
+
 # Reference well-known variantset ids (SearchVariantsExample.scala:27-31).
 PLATINUM_GENOMES = "3049512673186936334"
 THOUSAND_GENOMES_PHASE1 = "10473108253681171589"
@@ -91,6 +97,15 @@ class PcaConfig(GenomicsConfig):
     debug_datasets: bool = False
     min_allele_frequency: Optional[float] = None
     num_pc: int = 2
+    # Cohort sample restriction: `samples` keeps only the named callset
+    # ids (None = all), `exclude_samples` then drops ids. Ingest still
+    # extracts in the full callset frame; carriers are remapped/dropped
+    # at the window boundary, so the Gramian, finish, and emission are
+    # sized by the restricted cohort. This is the per-job cohort axis
+    # the serving tier's delta/gang paths ride (docs/OPERATIONS.md
+    # §4c); meshless uncheckpointed runs only.
+    samples: Optional[List[str]] = None
+    exclude_samples: Optional[List[str]] = None
     precise: bool = False  # host-f64 eigendecomposition (driver-side LAPACK analog)
     # PCA pipeline route. "auto" (default) runs the fused single-dispatch
     # finish (centering + CholeskyQR subspace eig + row sums in one
@@ -350,6 +365,25 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--debug-datasets", action="store_true")
     p.add_argument("--min-allele-frequency", type=float, default=None)
     p.add_argument("--num-pc", type=int, default=2)
+    p.add_argument(
+        "--samples",
+        type=_csv_list,
+        default=None,
+        help="Comma-separated callset ids restricting the cohort to "
+        "exactly these samples (default: every callset of the "
+        "variantsets). Ingest stays full-frame; carriers outside the "
+        "cohort drop at the window boundary, so results are identical "
+        "to a cohort containing only these samples. Meshless "
+        "uncheckpointed runs only",
+    )
+    p.add_argument(
+        "--exclude-samples",
+        type=_csv_list,
+        default=None,
+        help="Comma-separated callset ids dropped from the cohort "
+        "(applied after --samples); the ±k cohort-tweak axis the "
+        "serving tier's delta index resolves incrementally",
+    )
     p.add_argument(
         "--precise",
         action="store_true",
@@ -616,6 +650,36 @@ def add_analyze_flags(p: argparse.ArgumentParser) -> None:
         help="Result-cache entries kept (LRU), keyed on the cohort "
         "hash + analysis flags: identical submissions are served "
         "without recomputation, across tenants",
+    )
+    from spark_examples_tpu.serving.deltas import (
+        DEFAULT_DELTA_MAX_SAMPLES,
+        DEFAULT_GANG_MAX_SAMPLES,
+    )
+
+    p.add_argument(
+        "--delta-max-samples",
+        type=int,
+        default=DEFAULT_DELTA_MAX_SAMPLES,
+        help="Incremental serving: a submitted cohort whose sample set "
+        "differs from a cached ancestor's by at most this many samples "
+        "(same variantsets/references/AF filter) is answered by exact "
+        "rank-k corrections to the cached Gramian instead of a "
+        "from-scratch re-accumulation — bit-identical results, O(k*N) "
+        "touch-up instead of O(N*V) ingest; a checksum guard falls "
+        "back to cold on any cache doubt (docs/OPERATIONS.md §4c). "
+        "0 disables the delta tier",
+    )
+    p.add_argument(
+        "--gang-max-samples",
+        type=int,
+        default=DEFAULT_GANG_MAX_SAMPLES,
+        help="Gang batching: queued compatible jobs (same resolved "
+        "variantsets/references/AF filter, cohort size at most this) "
+        "coalesce into ONE batched Gramian dispatch — cohorts stacked "
+        "on a leading batch axis through a vmapped accumulator, one "
+        "jit cache entry, per-job results unstacked and journaled "
+        "individually (crash-safe replay semantics unchanged; results "
+        "bit-identical to serial execution). 0 disables gang batching",
     )
 
 

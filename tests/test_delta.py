@@ -1,0 +1,465 @@
+"""Incremental cohort deltas + gang-batched Gramians (ops + driver).
+
+The serving tier's marginal-job machinery (docs/OPERATIONS.md §4c):
+cohort sample restriction at the window boundary, exact rank-k sample
+corrections against cached Gramians (`ops/delta.py`), and the vmapped
+gang accumulator (`ops/gramian.gang_gramian_blockwise`). The contract
+under test everywhere is BIT-IDENTITY: a restricted run equals the full
+run's submatrix, a delta equals from-scratch, a gang member equals its
+serial run — exact integer counts in f32, so equality is `==`, never
+allclose.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.genomics.fixtures import (
+    DEFAULT_VARIANT_SET_ID,
+    synthetic_cohort,
+)
+from spark_examples_tpu.models.pca import VariantsPcaDriver
+from spark_examples_tpu.ops.delta import (
+    delta_gramian,
+    sample_correction,
+    signed_scatter_pairs,
+)
+from spark_examples_tpu.ops.gramian import gang_gramian_blockwise
+from spark_examples_tpu.ops.sparse import padded_carrier_matrix
+from spark_examples_tpu.serving import (
+    AnalysisEngine,
+    DeltaIndex,
+    JobSpec,
+    cohort_key,
+    gramian_base_key,
+    job_config,
+)
+from spark_examples_tpu.utils.config import PcaConfig
+
+REFS = "17:41196311:41277499"
+N, V = 12, 120
+
+
+def _conf(**kw):
+    kw.setdefault("variant_set_ids", [DEFAULT_VARIANT_SET_ID])
+    kw.setdefault("references", REFS)
+    kw.setdefault("bases_per_partition", 20_000)
+    kw.setdefault("block_variants", 16)
+    kw.setdefault("ingest_workers", 2)
+    return PcaConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def cohort():
+    src = synthetic_cohort(N, V, seed=11)
+    ids = [f"{DEFAULT_VARIANT_SET_ID}-{i}" for i in range(N)]
+    g_full = np.asarray(VariantsPcaDriver(_conf(), src).ingest_gramian())
+    return src, ids, g_full
+
+
+def _g(src, **kw):
+    return np.asarray(VariantsPcaDriver(_conf(**kw), src).ingest_gramian())
+
+
+class TestSampleRestriction:
+    def test_restricted_gramian_is_the_full_submatrix(self, cohort):
+        src, ids, g_full = cohort
+        keep = [0, 1, 3, 4, 5, 6, 8, 9, 10, 11]
+        g_sub = _g(src, exclude_samples=[ids[2], ids[7]])
+        assert np.array_equal(g_sub, g_full[np.ix_(keep, keep)])
+
+    def test_samples_include_list_and_order_independence(self, cohort):
+        src, ids, g_full = cohort
+        picked = [ids[5], ids[1], ids[9]]  # scrambled on purpose
+        g_sub = _g(src, samples=picked)
+        # The frame orders by FULL-index position, not by the user's
+        # list order — permuted lists are one cohort.
+        assert np.array_equal(
+            g_sub, g_full[np.ix_([1, 5, 9], [1, 5, 9])]
+        )
+        assert np.array_equal(g_sub, _g(src, samples=sorted(picked)))
+
+    def test_window_route_matches_block_route(self, cohort):
+        src, ids, _ = cohort
+        conf = _conf(exclude_samples=[ids[0], ids[4]])
+        driver = VariantsPcaDriver(conf, src)
+        g_blocks = np.asarray(driver.ingest_gramian())
+        g_windows = np.asarray(
+            VariantsPcaDriver(conf, src).ingest_gramian_windows()
+        )
+        assert np.array_equal(g_blocks, g_windows)
+
+    def test_unknown_and_empty_restrictions_are_loud(self, cohort):
+        src, ids, _ = cohort
+        with pytest.raises(ValueError, match="unknown sample"):
+            VariantsPcaDriver(
+                _conf(samples=["nope"]), src
+            ).ingest_gramian()
+        with pytest.raises(ValueError, match="no samples"):
+            VariantsPcaDriver(
+                _conf(samples=[ids[0]], exclude_samples=[ids[0]]), src
+            )
+        # An EXPLICITLY empty include list is a contradictory cohort —
+        # it must hit the same loud error, never silently run the full
+        # cohort (an empty exclude list IS the unrestricted cohort).
+        with pytest.raises(ValueError, match="no samples"):
+            VariantsPcaDriver(_conf(samples=[]), src)
+        full = VariantsPcaDriver(_conf(exclude_samples=[]), src)
+        assert full.cohort.size == len(ids)
+
+    def test_empty_samples_spec_fails_the_job_loudly(self, cohort):
+        src, _, _ = cohort
+        eng = AnalysisEngine(src)
+        with pytest.raises(ValueError, match="no samples"):
+            eng.run(job_config(JobSpec(samples=()), _conf()))
+        # The gang-size probe rejects the same restrictions the driver
+        # would (so doomed jobs never poison a gang).
+        with pytest.raises(ValueError, match="no samples"):
+            eng.cohort_size(job_config(JobSpec(samples=()), _conf()))
+        with pytest.raises(ValueError, match="unknown sample"):
+            eng.cohort_size(
+                job_config(JobSpec(samples=("ghost",)), _conf())
+            )
+
+    def test_restriction_rejects_checkpoint_and_mesh(self, cohort):
+        src, ids, _ = cohort
+        with pytest.raises(ValueError, match="checkpointed"):
+            VariantsPcaDriver(
+                _conf(samples=[ids[0]], checkpoint_dir="/tmp/x"), src
+            )
+        from spark_examples_tpu.parallel.mesh import make_mesh
+
+        with pytest.raises(ValueError, match="meshless"):
+            VariantsPcaDriver(
+                _conf(samples=[ids[0]]), src, mesh=make_mesh("data:2")
+            )
+
+
+class TestDeltaGramian:
+    def test_pure_removal_delta_is_bit_identical(self, cohort):
+        src, ids, g_full = cohort
+        target = _conf(exclude_samples=[ids[3], ids[8]])
+        driver = VariantsPcaDriver(target, src)
+        got = driver.ingest_gramian_delta(g_full, tuple(ids))
+        assert np.array_equal(got, np.asarray(driver.ingest_gramian()))
+
+    def test_add_and_remove_delta_is_bit_identical(self, cohort):
+        src, ids, _ = cohort
+        g_anc = _g(src, samples=ids[:8])
+        driver = VariantsPcaDriver(_conf(samples=ids[4:]), src)
+        got = driver.ingest_gramian_delta(g_anc, tuple(ids[:8]))
+        assert np.array_equal(got, np.asarray(driver.ingest_gramian()))
+
+    def test_delta_from_cached_windows_and_shuffled_order(self, cohort):
+        src, ids, _ = cohort
+        anc_driver = VariantsPcaDriver(_conf(samples=ids[:9]), src)
+        windows = []
+        g_anc = np.asarray(
+            anc_driver.ingest_gramian_windows(window_sink=windows)
+        )
+        assert windows, "cold window route must capture windows"
+        driver = VariantsPcaDriver(_conf(samples=ids[1:10]), src)
+        want = np.asarray(driver.ingest_gramian())
+        got = driver.ingest_gramian_delta(
+            g_anc, tuple(ids[:9]), windows=windows
+        )
+        assert np.array_equal(got, want)
+        # Window ARRIVAL order is irrelevant — exact integer counts.
+        shuffled = list(windows)
+        random.Random(5).shuffle(shuffled)
+        got2 = driver.ingest_gramian_delta(
+            g_anc, tuple(ids[:9]), windows=shuffled
+        )
+        assert np.array_equal(got2, want)
+
+    def test_sample_correction_columns_are_gramian_columns(self, cohort):
+        """C[:, t] must equal G's column for touched sample t — the
+        algebraic identity the delta path rests on."""
+        src, ids, g_full = cohort
+        driver = VariantsPcaDriver(_conf(), src)
+        windows = list(driver._cohort_windows(restrict=False))
+        touched = [2, 7, 11]
+        row_of_full = np.arange(N, dtype=np.int64)
+        col_of_full = np.full(N, len(touched), dtype=np.int64)
+        col_of_full[touched] = np.arange(len(touched))
+        corr = sample_correction(
+            windows, row_of_full, col_of_full, N, len(touched)
+        )
+        assert np.array_equal(corr, g_full[:, touched])
+
+    def test_signed_scatter_minus_cancels_plus(self):
+        lens = np.asarray([2, 3, 1, 0], dtype=np.int64)
+        idx = np.asarray([0, 2, 1, 3, 4, 2], dtype=np.int64)
+        mat = padded_carrier_matrix(idx, lens, sentinel=5, n_rows=256)
+        import jax.numpy as jnp
+
+        acc = signed_scatter_pairs(
+            jnp.zeros((5, 5), jnp.float32), mat, mat, sign=1
+        )
+        assert float(np.asarray(acc).sum()) > 0
+        acc = signed_scatter_pairs(acc, mat, mat, sign=-1)
+        assert np.array_equal(np.asarray(acc), np.zeros((5, 5)))
+        with pytest.raises(ValueError, match="sign"):
+            signed_scatter_pairs(acc, mat, mat, sign=2)
+
+    def test_frame_mismatch_is_loud(self, cohort):
+        src, ids, g_full = cohort
+        driver = VariantsPcaDriver(_conf(samples=ids[:4]), src)
+        with pytest.raises(ValueError, match="ancestor"):
+            driver.ingest_gramian_delta(
+                g_full[:3, :3], tuple(ids)
+            )
+
+    def test_delta_gramian_direct_api(self, cohort):
+        """delta_gramian against numpy-built ground truth, shuffled
+        ancestor frame order included."""
+        rng = np.random.default_rng(3)
+        n_full, n_var = 9, 40
+        x = (rng.random((n_full, n_var)) < 0.3).astype(np.int64)
+        windows = []
+        for lo in range(0, n_var, 16):
+            cols = x[:, lo : lo + 16]
+            lens = cols.sum(axis=0).astype(np.int64)
+            idx = np.concatenate(
+                [np.nonzero(cols[:, j])[0] for j in range(cols.shape[1])]
+            ) if lens.sum() else np.zeros(0, dtype=np.int64)
+            windows.append((idx, lens))
+        anc = np.asarray([7, 0, 3, 5, 1], dtype=np.int64)  # scrambled
+        tgt = np.asarray([0, 2, 3, 6, 7], dtype=np.int64)
+        g_anc = (x[anc] @ x[anc].T).astype(np.float32)
+        want = (x[tgt] @ x[tgt].T).astype(np.float32)
+        got = delta_gramian(g_anc, anc, tgt, n_full, windows)
+        assert np.array_equal(got, want)
+
+
+class TestGangGramian:
+    def test_gang_matches_serial_per_cohort(self, cohort):
+        src, ids, _ = cohort
+        cohorts = [ids[:5], ids[3:9], ids[1:]]
+        driver = VariantsPcaDriver(_conf(), src)
+        windows = list(driver._cohort_windows(restrict=False))
+        remaps, sizes = [], []
+        for members in cohorts:
+            sub, remap = driver.index.restricted(members)
+            remaps.append(remap)
+            sizes.append(sub.size)
+        g = gang_gramian_blockwise(
+            windows, remaps, max(sizes), block_variants=16
+        )
+        for b, members in enumerate(cohorts):
+            want = _g(src, samples=list(members))
+            assert np.array_equal(g[b, : sizes[b], : sizes[b]], want)
+            # Padding rows/cols beyond the cohort stay zero (inert).
+            assert not g[b, sizes[b] :, :].any()
+            assert not g[b, :, sizes[b] :].any()
+
+    def test_gang_is_order_independent(self, cohort):
+        src, ids, _ = cohort
+        driver = VariantsPcaDriver(_conf(), src)
+        windows = list(driver._cohort_windows(restrict=False))
+        _, remap = driver.index.restricted(ids[:6])
+        a = gang_gramian_blockwise(windows, [remap], 6, block_variants=16)
+        shuffled = list(windows)
+        random.Random(9).shuffle(shuffled)
+        b = gang_gramian_blockwise(
+            shuffled, [remap], 6, block_variants=16
+        )
+        assert np.array_equal(a, b)
+
+    def test_empty_gang_is_loud(self):
+        with pytest.raises(ValueError, match=">= 1 cohort"):
+            gang_gramian_blockwise(iter(()), [], 4)
+
+
+class TestSpecSurface:
+    def test_spec_sample_fields_validate_and_canonicalize(self):
+        spec = JobSpec.from_record(
+            {"samples": ["b", "a", "b"], "exclude_samples": ["z"]}
+        )
+        assert spec.samples == ("a", "b")
+        assert spec.exclude_samples == ("z",)
+        with pytest.raises(ValueError, match="samples"):
+            JobSpec.from_record({"samples": [1]})
+        with pytest.raises(ValueError, match="exclude_samples"):
+            JobSpec.from_record({"exclude_samples": "notalist"})
+        rt = JobSpec.from_record(spec.to_record())
+        assert rt == spec
+
+    def test_cohort_key_covers_sample_restriction(self):
+        base = _conf()
+        assert cohort_key(JobSpec(), base) != cohort_key(
+            JobSpec(samples=("a",)), base
+        )
+        # Permutations canonicalize to one key via from_record.
+        a = JobSpec.from_record({"samples": ["a", "b"]})
+        b = JobSpec.from_record({"samples": ["b", "a"]})
+        assert cohort_key(a, base) == cohort_key(b, base)
+
+    def test_gramian_base_key_excludes_samples_and_num_pc(self):
+        base = _conf()
+        k0 = gramian_base_key(job_config(JobSpec(), base))
+        assert k0 == gramian_base_key(
+            job_config(JobSpec(samples=("a",), num_pc=5), base)
+        )
+        assert k0 != gramian_base_key(
+            job_config(JobSpec(min_allele_frequency=0.25), base)
+        )
+
+
+class TestServingAcceptance:
+    """The ISSUE's measured bars, pinned where CI can hold them: a
+    ±16-sample delta ≥10× faster than the cold run of the same cohort
+    (bit-identical), and gang-batched drain strictly faster than
+    serial (jobs/s strictly above) with bit-identical per-job rows.
+    Every executable is warmed on its exact shape before any timed
+    window — these compare serving work, not XLA compiles.
+    BENCH_SERVE_r01.json records the bench-scale capture."""
+
+    def test_delta_10x_faster_than_cold_bit_identical(self):
+        import time
+
+        # v sized so the cold run's O(N·V) ingest dominates its ~70 ms
+        # fixed costs several times over: the ≥10× bar then reflects
+        # the structural O(k·N)-vs-O(N·V) gap, not scheduler luck.
+        n, v, cohort_n = 96, 16000, 48
+        src = synthetic_cohort(
+            n, v, seed=6, sparse_calls=True, rare_variant_af=0.02
+        )
+        ids = [f"{DEFAULT_VARIANT_SET_ID}-{i}" for i in range(n)]
+        base = dict(block_variants=512, ingest_workers=2)
+        anc_conf = _conf(samples=ids[:cohort_n], **base)
+        target = sorted(ids[8 : cohort_n + 8])
+        target_conf = _conf(samples=target, **base)
+        cold_engine = AnalysisEngine(src)
+        # Warm the TARGET cohort end to end (not just the ancestor): a
+        # near-degenerate spectrum makes the fused finish retry with a
+        # NEW executable whose compile would otherwise land in the
+        # timed cold leg and fake the speedup.
+        AnalysisEngine(src).run(target_conf)
+        warm = sorted(ids[: cohort_n - 8] + ids[cohort_n : cohort_n + 8])
+        warm_conf = _conf(samples=warm, **base)
+        # Best-of-N on BOTH legs (the bench discipline): a single
+        # measurement under full-suite load turns scheduler noise into
+        # flaky acceptance verdicts.
+        t_cold = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            rows_cold = cold_engine.run(target_conf)
+            t_cold = min(t_cold, time.perf_counter() - t0)
+
+        def delta_once():
+            # A fresh engine per repeat: re-running the tweak on one
+            # engine would resolve its own cached result as an
+            # exact-frame hit and time the zero-delta return, not the
+            # rank-k correction.
+            eng = AnalysisEngine(src, delta_max_samples=16)
+            eng.run(anc_conf)  # cache the ancestor (cold)
+            assert eng.delta_resolvable(warm_conf)
+            eng.run(warm_conf)  # warm the correction executable
+            assert eng.delta_resolvable(target_conf)
+            t0 = time.perf_counter()
+            rows = eng.run(target_conf)
+            return time.perf_counter() - t0, rows
+
+        (t_delta, rows_delta) = min(
+            (delta_once() for _ in range(3)), key=lambda r: r[0]
+        )
+        assert rows_delta == rows_cold
+        assert t_delta * 10 < t_cold, (
+            f"±16-sample delta must be >=10x faster than cold: "
+            f"delta {t_delta:.4f}s vs cold {t_cold:.4f}s "
+            f"({t_cold / max(t_delta, 1e-9):.1f}x)"
+        )
+
+    def test_gang_drain_strictly_faster_than_serial(self):
+        import time
+
+        from spark_examples_tpu.serving import AnalysisJobTier
+
+        n, v, cohort_n, n_jobs = 64, 1200, 32, 6
+        src = synthetic_cohort(n, v, seed=8, sparse_calls=True)
+        ids = [f"{DEFAULT_VARIANT_SET_ID}-{i}" for i in range(n)]
+        base = _conf(block_variants=512, ingest_workers=2)
+        specs = [
+            JobSpec(
+                samples=tuple(
+                    sorted(ids[(i * 5 + j) % n] for j in range(cohort_n))
+                )
+            )
+            for i in range(n_jobs)
+        ]
+
+        def drain(gang_max):
+            tier = AnalysisJobTier(
+                AnalysisEngine(src),
+                base,
+                workers=0,
+                queue_depth=64,
+                tenant_quota=64,
+                gang_max_samples=gang_max,
+            )
+            jobs = [tier.submit(s)[0] for s in specs]
+            t0 = time.perf_counter()
+            # timeout=0: queue pre-filled, workers=0 — a blocking final
+            # pop would count its whole wait against the timed leg.
+            while tier.step(timeout=0.0):
+                pass
+            dt = time.perf_counter() - t0
+            assert all(j.state == "done" for j in jobs)
+            rows = [j.result for j in jobs]
+            tier.close()
+            return dt, rows
+
+        drain(0)  # warm serial-shape executables
+        drain(cohort_n)  # warm the batched accumulator
+        t_serial, rows_serial = drain(0)
+        t_gang, rows_gang = drain(cohort_n)
+        assert rows_gang == rows_serial
+        assert t_gang < t_serial, (
+            f"gang-batched jobs/s must be strictly above serial: "
+            f"gang {n_jobs / t_gang:.2f}/s vs serial "
+            f"{n_jobs / t_serial:.2f}/s"
+        )
+
+
+class TestDeltaIndex:
+    def test_nearest_ancestor_resolution_and_bounds(self):
+        idx = DeltaIndex(max_delta_samples=2)
+        g = np.eye(3, dtype=np.float32)
+        idx.put("k", ("a", "b", "c"), g)
+        idx.put("k", ("a", "b", "x"), g)
+        # Exact frame wins at distance 0.
+        assert idx.resolve("k", ("a", "b", "c")).samples == (
+            "a", "b", "c",
+        )
+        # Distance 1 within bound; distance 3 out of bound; other base
+        # keys never match.
+        assert idx.resolve("k", ("a", "b")) is not None
+        assert idx.resolve("k", ("q", "r", "s", "t", "u")) is None
+        assert idx.resolve("other", ("a", "b", "c")) is None
+
+    def test_checksum_guard_detects_corruption(self):
+        idx = DeltaIndex(max_delta_samples=4)
+        idx.put("k", ("a",), np.ones((2, 2), dtype=np.float32))
+        entry = idx.resolve("k", ("a",))
+        assert entry.verify()
+        entry.g[0, 0] = 41.0  # bit rot / accidental mutation
+        assert not entry.verify()
+        idx.drop(entry)
+        assert idx.resolve("k", ("a",)) is None
+
+    def test_engine_fallback_on_corrupt_cache_is_still_exact(self):
+        src = synthetic_cohort(8, 60, seed=4)
+        ids = [f"{DEFAULT_VARIANT_SET_ID}-{i}" for i in range(8)]
+        eng = AnalysisEngine(src, delta_max_samples=16)
+        base = _conf()
+        eng.run(base)
+        # Corrupt the cached ancestor in place: the checksum guard must
+        # fall back to cold and the answer must not change.
+        entry = eng._deltas.resolve(gramian_base_key(base), tuple(ids))
+        entry.g[0, 0] += 1.0
+        tweaked = _conf(exclude_samples=[ids[2]])
+        got = eng.run(tweaked)
+        want = AnalysisEngine(src).run(tweaked)
+        assert got == want

@@ -44,6 +44,7 @@ from spark_examples_tpu.serving import (
     QueueFullError,
     QuotaExceededError,
     cohort_key,
+    job_config,
 )
 from spark_examples_tpu.serving.queue import AdmissionQueue
 from spark_examples_tpu.utils.config import PcaConfig
@@ -875,6 +876,334 @@ class TestAnalyzeHttp:
             tier.close()
 
 
+def _sample_ids(n):
+    return [f"{DEFAULT_VARIANT_SET_ID}-{i}" for i in range(n)]
+
+
+class TestDeltaServing:
+    """The incremental tier end to end: nearest-ancestor resolution,
+    bit-identity vs cold, outcome accounting, fallback guard."""
+
+    def _tiers(self, src, base, tmp_path, **kw):
+        return AnalysisJobTier(
+            AnalysisEngine(src, delta_max_samples=16),
+            base,
+            workers=0,
+            journal_dir=str(tmp_path / "j"),
+            **kw,
+        )
+
+    def test_delta_rows_bit_identical_to_cold(
+        self, served_source, tmp_path
+    ):
+        src, base, _ = served_source
+        ids = _sample_ids(8)
+        tier = self._tiers(src, base, tmp_path)
+        tier.submit(JobSpec())  # warms the delta cache (miss → cold)
+        assert tier.step(timeout=1.0)
+        job = tier.submit(JobSpec(exclude_samples=(ids[1], ids[5])))[0]
+        assert tier.step(timeout=1.0)
+        assert job.state == "done", job.error
+        cold = AnalysisEngine(src).run(
+            job_config(
+                JobSpec(exclude_samples=(ids[1], ids[5])), base
+            )
+        )
+        assert job.result == cold
+        tier.close()
+
+    def test_num_pc_tweak_is_a_zero_delta_hit(
+        self, served_source, tmp_path
+    ):
+        src, base, _ = served_source
+        tier = self._tiers(src, base, tmp_path)
+        engine = tier._engine
+        tier.submit(JobSpec(num_pc=2))
+        assert tier.step(timeout=1.0)
+        # Same frame, different finish: the gramian must come straight
+        # from the cache (zero-sample delta), and the rows must match
+        # a cold engine exactly.
+        job = tier.submit(JobSpec(num_pc=3))[0]
+        assert engine.delta_resolvable(
+            job_config(JobSpec(num_pc=3), base)
+        )
+        assert tier.step(timeout=1.0)
+        assert job.state == "done", job.error
+        assert job.result == AnalysisEngine(src).run(
+            job_config(JobSpec(num_pc=3), base)
+        )
+        tier.close()
+
+    def test_af_tweak_misses_the_delta_index(
+        self, served_source, tmp_path
+    ):
+        src, base, _ = served_source
+        tier = self._tiers(src, base, tmp_path)
+        tier.submit(JobSpec())
+        assert tier.step(timeout=1.0)
+        # A filter tweak changes the base key — no ancestor, cold run,
+        # still correct.
+        spec = JobSpec(min_allele_frequency=0.3)
+        assert not tier._engine.delta_resolvable(
+            job_config(spec, base)
+        )
+        job = tier.submit(spec)[0]
+        assert tier.step(timeout=1.0)
+        assert job.state == "done", job.error
+        assert job.result == AnalysisEngine(src).run(
+            job_config(spec, base)
+        )
+        tier.close()
+
+    def test_delta_telemetry_and_outcome_counters(
+        self, served_source, tmp_path
+    ):
+        src, base, _ = served_source
+        ids = _sample_ids(8)
+        trace = str(tmp_path / "delta.trace.json")
+        metrics = str(tmp_path / "delta.prom")
+        with TelemetrySession(trace_out=trace, metrics_out=metrics):
+            tier = self._tiers(src, base, tmp_path)
+            tier.submit(JobSpec())  # miss
+            tier.step(timeout=1.0)
+            tier.submit(JobSpec(exclude_samples=(ids[3],)))  # hit
+            tier.step(timeout=1.0)
+            # Corrupt the cached entries: guard → fallback.
+            from spark_examples_tpu.serving import gramian_base_key
+
+            key = gramian_base_key(job_config(JobSpec(), base))
+            for frame in (tuple(ids), tuple(
+                i for i in ids if i != ids[3]
+            )):
+                entry = tier._engine._deltas.resolve(key, frame)
+                if entry is not None:
+                    entry.g[0, 0] += 1.0
+            tier.submit(JobSpec(exclude_samples=(ids[2],)))  # fallback
+            tier.step(timeout=1.0)
+            tier.close()
+        assert validate.validate_trace(trace) == []
+        assert validate.validate_metrics(metrics) == []
+        events = json.loads(open(trace).read())["traceEvents"]
+        deltas = [e for e in events if e.get("name") == "job.delta"]
+        assert deltas and deltas[0]["args"]["removed"] == 1
+        prom = open(metrics).read()
+        assert 'serving_delta_jobs_total{outcome="miss"} 1' in prom
+        assert 'serving_delta_jobs_total{outcome="hit"} 1' in prom
+        assert 'serving_delta_jobs_total{outcome="fallback"} 1' in prom
+
+
+class TestGangServing:
+    """Gang batching end to end: coalescing policy, bit-identity vs
+    serial, journal/crash semantics, telemetry."""
+
+    def _tier(self, src, base, tmp_path, name, **kw):
+        kw.setdefault("gang_max_samples", 64)
+        kw.setdefault("workers", 0)
+        return AnalysisJobTier(
+            AnalysisEngine(src),
+            base,
+            journal_dir=str(tmp_path / name),
+            **kw,
+        )
+
+    def _specs(self):
+        ids = _sample_ids(8)
+        return [
+            JobSpec(samples=tuple(ids[:5])),
+            JobSpec(samples=tuple(ids[2:8])),
+            JobSpec(exclude_samples=(ids[0],)),
+            JobSpec(min_allele_frequency=0.2),  # different base key
+        ]
+
+    def test_gang_results_bit_identical_to_serial(
+        self, served_source, tmp_path
+    ):
+        src, base, _ = served_source
+        specs = self._specs()
+        gang_tier = self._tier(src, base, tmp_path, "gang")
+        gang_jobs = [gang_tier.submit(s)[0] for s in specs]
+        steps = 0
+        while gang_tier.step(timeout=0.2):
+            steps += 1
+        # One gang (the three same-base-key cohorts) + one solo.
+        assert steps == 2
+        serial_tier = self._tier(
+            src, base, tmp_path, "serial", gang_max_samples=0
+        )
+        serial_jobs = [serial_tier.submit(s)[0] for s in specs]
+        while serial_tier.step(timeout=0.2):
+            pass
+        for g, s in zip(gang_jobs, serial_jobs):
+            assert g.state == "done", g.error
+            assert g.result == s.result
+        gang_tier.close()
+        serial_tier.close()
+
+    def test_gang_cap_splits_oversized_cohorts_out(
+        self, served_source, tmp_path
+    ):
+        src, base, _ = served_source
+        ids = _sample_ids(8)
+        tier = self._tier(
+            src, base, tmp_path, "cap", gang_max_samples=4
+        )
+        jobs = [
+            tier.submit(JobSpec(samples=tuple(ids[:3])))[0],
+            tier.submit(JobSpec(samples=tuple(ids[3:6])))[0],
+            tier.submit(JobSpec())[0],  # N=8 > cap: solo
+        ]
+        steps = 0
+        while tier.step(timeout=0.2):
+            steps += 1
+        assert steps == 2
+        assert all(j.state == "done" for j in jobs)
+        tier.close()
+
+    def test_gang_telemetry(self, served_source, tmp_path):
+        src, base, _ = served_source
+        trace = str(tmp_path / "gang.trace.json")
+        metrics = str(tmp_path / "gang.prom")
+        with TelemetrySession(trace_out=trace, metrics_out=metrics):
+            tier = self._tier(src, base, tmp_path, "tele")
+            for s in self._specs()[:3]:
+                tier.submit(s)
+            while tier.step(timeout=0.2):
+                pass
+            tier.close()
+        assert validate.validate_trace(trace) == []
+        assert validate.validate_metrics(metrics) == []
+        events = json.loads(open(trace).read())["traceEvents"]
+        gangs = [e for e in events if e.get("name") == "job.gang"]
+        assert gangs and gangs[0]["args"]["size"] == 3
+        prom = open(metrics).read()
+        assert "serving_gang_size_bucket" in prom
+        assert "serving_gang_size_count 1" in prom
+
+    def test_kill_mid_gang_restart_replays_bit_identical(
+        self, served_source, tmp_path
+    ):
+        """The chaos contract: a simulated process death between the
+        gang members' journaled starts and execution re-queues every
+        member on restart, and re-execution (whatever gang shape it
+        lands in) is bit-identical to an uninterrupted serial run."""
+        from spark_examples_tpu.serving import SimulatedCrash
+
+        src, base, _ = served_source
+        specs = self._specs()[:3]
+        baseline_tier = self._tier(src, base, tmp_path, "base")
+        baselines = [baseline_tier.submit(s)[0] for s in specs]
+        while baseline_tier.step(timeout=0.2):
+            pass
+        baseline_tier.close()
+
+        journal = str(tmp_path / "crashj")
+        tier = AnalysisJobTier(
+            AnalysisEngine(src),
+            base,
+            workers=0,
+            gang_max_samples=64,
+            journal_dir=journal,
+        )
+        jobs = [tier.submit(s)[0] for s in specs]
+        plan = FaultPlan(
+            seed=1,
+            rules=[
+                FaultRule(
+                    site="serving.job.kill",
+                    kind="error",
+                    match=jobs[1].id,
+                )
+            ],
+        )
+        with faults.active_plan(plan):
+            with pytest.raises(SimulatedCrash):
+                tier.step(timeout=0.2)
+        # The "dead" tier: every member journaled a start, none a
+        # terminal event; all three are abandoned mid-gang.
+        assert all(j.state == "running" for j in jobs)
+        tier._journal.close()
+        resumed = AnalysisJobTier(
+            AnalysisEngine(src),
+            base,
+            workers=0,
+            gang_max_samples=64,
+            journal_dir=journal,
+        )
+        while resumed.step(timeout=0.2):
+            pass
+        by_key = {j.key: j for j in resumed.jobs()}
+        for spec, want in zip(specs, baselines):
+            got = by_key.get(cohort_key(spec, base))
+            assert got is not None and got.state == "done", got
+            assert got.result == want.result
+        resumed.close()
+
+
+class TestDeltaGangSchemaDrift:
+    """Both rejection directions for the delta/gang obs surface: the
+    new spans are schema-known, an unknown job.* span still fails the
+    trace gate, a ``serving_delta_jobs_total`` sample without its
+    outcome label fails the metrics gate, and a ``serving_gang_size``
+    histogram missing its triplet fails too (GL003 cross-checks the
+    same sets statically, both directions)."""
+
+    @staticmethod
+    def _trace_with(tmp_path, name):
+        trace = tmp_path / "t.json"
+        trace.write_text(
+            json.dumps(
+                {
+                    "traceEvents": [
+                        {
+                            "ph": "X",
+                            "name": name,
+                            "pid": 1,
+                            "ts": 0,
+                            "dur": 1,
+                        }
+                    ]
+                }
+            )
+        )
+        return str(trace)
+
+    def test_delta_and_gang_spans_are_schema_known(self, tmp_path):
+        for name in ("job.delta", "job.gang"):
+            assert (
+                validate.validate_trace(self._trace_with(tmp_path, name))
+                == []
+            )
+
+    def test_unknown_job_span_rejected(self, tmp_path):
+        errs = validate.validate_trace(
+            self._trace_with(tmp_path, "job.batch")
+        )
+        assert errs and "job.batch" in errs[0]
+
+    def test_delta_counter_requires_outcome_label(self, tmp_path):
+        good = tmp_path / "good.prom"
+        good.write_text('serving_delta_jobs_total{outcome="hit"} 2\n')
+        assert validate.validate_metrics(str(good)) == []
+        bad = tmp_path / "bad.prom"
+        bad.write_text("serving_delta_jobs_total 2\n")
+        errs = validate.validate_metrics(str(bad))
+        assert errs and "outcome" in errs[0]
+
+    def test_gang_histogram_requires_the_triplet(self, tmp_path):
+        good = tmp_path / "good.prom"
+        good.write_text(
+            'serving_gang_size_bucket{le="4"} 1\n'
+            'serving_gang_size_bucket{le="+Inf"} 1\n'
+            "serving_gang_size_sum 3\n"
+            "serving_gang_size_count 1\n"
+        )
+        assert validate.validate_metrics(str(good)) == []
+        bad = tmp_path / "bad.prom"
+        bad.write_text('serving_gang_size_bucket{le="+Inf"} 1\n')
+        errs = validate.validate_metrics(str(bad))
+        assert errs and any("_sum" in e for e in errs)
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -935,14 +1264,33 @@ class TestServiceChaosSoak:
                     "1",
                     "--analyze-journal-dir",
                     journal,
+                    # The incremental/batched serving surface rides the
+                    # same soak: compatible submissions may gang, ±k
+                    # cohorts may resolve through the delta index —
+                    # results must stay bit-identical through kill -9
+                    # either way.
+                    "--delta-max-samples",
+                    "16",
+                    "--gang-max-samples",
+                    "64",
                 ],
                 env={**os.environ, "JAX_PLATFORMS": "cpu"},
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,
             )
 
+        excluded = _sample_ids(10)[3]
         for i in range(iters):
             spec = {"tenant": "soak", "num_pc": 2 + i}
+            # A second, sample-restricted submission in the same
+            # breath: with gangs/deltas on, the pair may coalesce or
+            # resolve incrementally — the kill must leave BOTH
+            # replayable to bit-identical coordinates.
+            spec2 = {
+                "tenant": "soak",
+                "num_pc": 2 + i,
+                "exclude_samples": [excluded],
+            }
             conf = PcaConfig(
                 **{
                     **base.__dict__,
@@ -950,11 +1298,20 @@ class TestServiceChaosSoak:
                     "input_path": None,
                 }
             )
+            conf2 = PcaConfig(
+                **{
+                    **base.__dict__,
+                    "num_pc": 2 + i,
+                    "exclude_samples": [excluded],
+                    "input_path": None,
+                }
+            )
             key = (2 + i,)
+            key2 = (2 + i, excluded)
             if key not in baselines:
-                baselines[key] = AnalysisEngine(JsonlSource(root)).run(
-                    conf
-                )
+                engine = AnalysisEngine(JsonlSource(root))
+                baselines[key] = engine.run(conf)
+                baselines[key2] = engine.run(conf2)
             port = _free_port()
             proc = serve(port)
             jid = None
@@ -963,6 +1320,9 @@ class TestServiceChaosSoak:
                 st, _, doc = _post(conn, "/analyze", spec)
                 assert st == 202, doc
                 jid = doc["id"]
+                st, _, doc2 = _post(conn, "/analyze", spec2)
+                assert st == 202, doc2
+                jid2 = doc2["id"]
                 # Kill as soon as the job leaves the queue — a SIGKILL
                 # mid-run, start journaled, no terminal event.
                 deadline = time.time() + 120
@@ -981,22 +1341,25 @@ class TestServiceChaosSoak:
             proc = serve(port)
             try:
                 conn = _wait_http(port)
-                deadline = time.time() + 240
-                jd = None
-                while time.time() < deadline:
-                    st, jd = _get(conn, f"/jobs/{jid}")
-                    assert st == 200, f"job {jid} lost across restart"
-                    if jd["state"] in ("done", "failed"):
-                        break
-                    time.sleep(0.1)
-                assert jd and jd["state"] == "done", jd
-                got = [tuple(r) for r in jd["result"]]
-                want = baselines[key]
-                assert [r[0] for r in got] == [r[0] for r in want]
-                np.testing.assert_array_equal(
-                    np.array([[r[1], r[2]] for r in got]),
-                    np.array([[r[1], r[2]] for r in want]),
-                )
+                for want_key, want_jid in ((key, jid), (key2, jid2)):
+                    deadline = time.time() + 240
+                    jd = None
+                    while time.time() < deadline:
+                        st, jd = _get(conn, f"/jobs/{want_jid}")
+                        assert st == 200, (
+                            f"job {want_jid} lost across restart"
+                        )
+                        if jd["state"] in ("done", "failed"):
+                            break
+                        time.sleep(0.1)
+                    assert jd and jd["state"] == "done", jd
+                    got = [tuple(r) for r in jd["result"]]
+                    want = baselines[want_key]
+                    assert [r[0] for r in got] == [r[0] for r in want]
+                    np.testing.assert_array_equal(
+                        np.array([[r[1], r[2]] for r in got]),
+                        np.array([[r[1], r[2]] for r in want]),
+                    )
             finally:
                 proc.terminate()
                 proc.wait(timeout=30)

@@ -227,7 +227,7 @@ class SpanContractRule:
         # 4-5. Metric contract: required names registered, with the
         # labels the schema's sample checks demand.
         regs = extract_metric_registrations(project)
-        required = {
+        required: Dict[str, Optional[str]] = {
             name: "transport"
             for name in getattr(schema, "_WIRE_COUNTERS", ())
         }
@@ -242,6 +242,10 @@ class SpanContractRule:
         # Serving/resilience counters: the schema names the label each
         # sample must carry (breaker probes, job outcomes, sheds).
         required.update(getattr(schema, "_LABELED_COUNTERS", {}))
+        # Plain serving histograms: registration required, no label
+        # contract (None = skip the label check).
+        for name in getattr(schema, "_SERVING_HISTOGRAMS", ()):
+            required[name] = None
         for name, label in sorted(required.items()):
             sites = regs.get(name)
             if not sites:
@@ -258,6 +262,8 @@ class SpanContractRule:
                 )
                 continue
             for rel, line, _kind, labels in sites:
+                if label is None:
+                    continue
                 if label not in labels:
                     findings.append(
                         Finding(
