@@ -229,6 +229,8 @@ _INGEST_HISTOGRAM = "ingest_block_build_seconds"
 _LABELED_COUNTERS = {
     "breaker_probe_total": "outcome",     # half-open probe outcomes
     "cold_stream_shards_total": "stage",  # fetched/accumulated per shard
+    "collective_check_steps_total": "outcome",  # agree/divergence per
+                                          # cross-checked pod step
     "serving_delta_jobs_total": "outcome",  # hit/fallback/miss
     "serving_jobs_total": "outcome",      # done/failed/cached/deduped
     "serving_shed_total": "reason",       # queue_full/quota

@@ -31,7 +31,7 @@ changing results.
 from __future__ import annotations
 
 from functools import partial
-from typing import Iterable, List, Tuple
+from typing import Any, Iterable, List, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -56,7 +56,9 @@ __all__ = [
 
 
 @partial(jax.jit, donate_argnums=(0,), static_argnames=("sign",))
-def _signed_scatter_jit(acc, row_idx, col_idx, sign):
+def _signed_scatter_jit(
+    acc: Any, row_idx: Any, col_idx: Any, sign: int
+) -> Any:
     """``acc[row_idx[v,a], col_idx[v,b]] += sign`` for every (v, a, b),
     out-of-bounds indices dropped — the ±1 twin of
     :func:`spark_examples_tpu.ops.sparse.scatter_pairs_chunked`, chunked
@@ -72,7 +74,7 @@ def _signed_scatter_jit(acc, row_idx, col_idx, sign):
     )
     shape_c = (shape_r[0], SCATTER_CHUNK_VARIANTS, col_idx.shape[1])
 
-    def body(g, chunk):
+    def body(g: Any, chunk: Any) -> Any:
         ci, cj = chunk
         return (
             g.at[ci[:, :, None], cj[:, None, :]].add(unit, mode="drop"),
@@ -87,7 +89,9 @@ def _signed_scatter_jit(acc, row_idx, col_idx, sign):
     return acc
 
 
-def signed_scatter_pairs(acc, row_idx, col_idx, sign: int = 1):
+def signed_scatter_pairs(
+    acc: Any, row_idx: Any, col_idx: Any, sign: int = 1
+) -> Any:
     """Public entry: scatter ``±1`` at every (row, col) carrier pair of
     every variant, OOB dropped. ``row_idx``/``col_idx`` are padded
     carrier matrices (``padded_carrier_matrix``) whose variant axes must
@@ -113,7 +117,9 @@ def _pow2_rows(rows: int) -> int:
 
 
 @partial(jax.jit, static_argnames=("sign", "compute_dtype"))
-def _dense_correction_jit(xr, xc, sign, compute_dtype):
+def _dense_correction_jit(
+    xr: Any, xc: Any, sign: int, compute_dtype: Any
+) -> Any:
     prod = mxu_cross_product_pair(xr, xc, jnp.float32, compute_dtype)
     return prod * jnp.asarray(sign, jnp.float32)
 

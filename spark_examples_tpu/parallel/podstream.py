@@ -77,16 +77,20 @@ POD_EXCHANGE_TIMEOUT_S = 1800.0
 
 _STREAM_IDS = itertools.count()
 
-# Frame kinds on the wire.
+# Frame kinds on the wire. _KIND_CHECK carries the optional
+# collective-congruence digest (utils/collectivecheck): it is only ever
+# posted when EVERY live process advertised the check in its step
+# header, so a mixed-enablement pod never desyncs on unexpected frames.
 _KIND_HEADER = 0
 _KIND_CONFIRM = 1
 _KIND_PAYLOAD = 2
+_KIND_CHECK = 3
 
 # stream (q), step (q), kind (B), byte length (q) — little-endian.
 _FRAME = struct.Struct("<qqBq")
 
 
-def coordination_client():
+def coordination_client() -> Any:
     """The jax.distributed coordination-service client, or ``None``.
 
     Present on every process of a multi-process jax run (it is what
@@ -142,7 +146,7 @@ class _PeerSender:
     buffers would deadlock the pod. The queue is unbounded but its depth
     is governed by the pipeline depth (a handful of frames)."""
 
-    def __init__(self, sock: socket.socket, peer: int):
+    def __init__(self, sock: socket.socket, peer: int) -> None:
         self._sock = sock
         self._q: "queue.Queue[Optional[bytes]]" = queue.Queue()
         self._thread = threading.Thread(
@@ -183,7 +187,7 @@ class _PodSocketMesh:
     _instance: Optional["_PodSocketMesh"] = None
     _instance_lock = threading.Lock()
 
-    def __init__(self, pid: int, world: int, timeout_s: float):
+    def __init__(self, pid: int, world: int, timeout_s: float) -> None:
         self._pid = pid
         self._world = world
         self._timeout_s = timeout_s
@@ -248,7 +252,7 @@ class _PodSocketMesh:
             f"pod_exchange/addr/{self._pid}", addr.encode()
         )
         timeout_ms = int(timeout_s * 1000)
-        peers = {}
+        peers: Dict[int, Tuple[str, int]] = {}
         for p in range(self._world):
             if p == self._pid:
                 continue
@@ -377,7 +381,7 @@ class PodWindowExchange:
     header.
     """
 
-    def __init__(self, mesh: _PodSocketMesh, pid: int, world: int):
+    def __init__(self, mesh: _PodSocketMesh, pid: int, world: int) -> None:
         self._mesh = mesh
         self._pid = pid
         self._world = world
@@ -386,6 +390,7 @@ class PodWindowExchange:
         # is needed (the allgather semantics include the local row).
         self._own_header = np.zeros(0, np.int64)
         self._own_confirm = np.int64(0)
+        self._own_check = np.int64(0)
 
     @property
     def stream(self) -> int:
@@ -396,7 +401,9 @@ class PodWindowExchange:
         return self._stream
 
     @classmethod
-    def open(cls, timeout_s: float = POD_EXCHANGE_TIMEOUT_S):
+    def open(
+        cls, timeout_s: float = POD_EXCHANGE_TIMEOUT_S
+    ) -> Optional["PodWindowExchange"]:
         """Exchange for this process, or ``None`` without a
         coordination client (single-process). Call from the MAIN
         thread: first use bootstraps the socket mesh through the
@@ -457,13 +464,43 @@ class PodWindowExchange:
             )[0]
         return vals
 
+    def post_check(self, step: int, digest: int) -> None:
+        """Post this process's collective-congruence digest for one
+        step (non-negative int64 — see utils/collectivecheck). Only
+        call when the gathered headers agreed every live process has
+        the check enabled."""
+        self._own_check = np.int64(digest)
+        self._post_all(
+            step,
+            _KIND_CHECK,
+            np.array([self._own_check], np.int64).tobytes(),
+        )
+
+    def gather_checks(self, step: int) -> np.ndarray:
+        """(world,) int64 — every process's step digest (own value
+        included, like the header/confirm gathers)."""
+        vals = np.empty(self._world, np.int64)
+        for p in range(self._world):
+            if p == self._pid:
+                vals[p] = self._own_check
+                continue
+            vals[p] = np.frombuffer(
+                self._mesh.recv(p, self._stream, step, _KIND_CHECK),
+                dtype=np.int64,
+            )[0]
+        return vals
+
     def post_payload(self, step: int, mat: np.ndarray) -> None:
         self._post_all(
             step, _KIND_PAYLOAD, np.ascontiguousarray(mat).tobytes()
         )
 
     def get_payload(
-        self, step: int, peer: int, shape: Tuple[int, ...], dtype=np.int32
+        self,
+        step: int,
+        peer: int,
+        shape: Tuple[int, ...],
+        dtype: Any = np.int32,
     ) -> np.ndarray:
         raw = np.frombuffer(
             self._mesh.recv(peer, self._stream, step, _KIND_PAYLOAD),
@@ -510,7 +547,9 @@ class SlotPipeline:
     step per consumer pull — the ablation/debug mode.
     """
 
-    def __init__(self, produce: Callable[[], Optional[PodSlot]], depth: int):
+    def __init__(
+        self, produce: Callable[[], Optional[PodSlot]], depth: int
+    ) -> None:
         if depth < 0:
             raise ValueError(f"pipeline depth must be >= 0, got {depth}")
         self._produce = produce

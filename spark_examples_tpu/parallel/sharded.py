@@ -973,6 +973,7 @@ def _synced_carrier_stream(
         PodWindowExchange,
         SlotPipeline,
     )
+    from spark_examples_tpu.utils import collectivecheck
 
     if coalesce_variants is None:
         coalesce_variants = DEFAULT_POD_COALESCE_VARIANTS
@@ -1063,6 +1064,12 @@ def _synced_carrier_stream(
                 ).num
         except Exception as e:  # noqa: BLE001 — synced below, see docstring
             exc, code = e, -2
+        # The collective-check backstop's enablement rides the header
+        # (field 6) so the digest exchange below is an AGREED step: it
+        # runs only when every process advertised it, and a
+        # mixed-enablement pod degrades to unchecked instead of
+        # desyncing on unexpected frames.
+        check_flag = 1 if collectivecheck.collective_check_enabled() else 0
         with obs.span(
             "gramian.sparse.allgather",
             step=step,
@@ -1072,9 +1079,12 @@ def _synced_carrier_stream(
         ):
             exchange.post_header(
                 step,
-                np.array([code, k_max, rows, num, nnz, nwin], np.int64),
+                np.array(
+                    [code, k_max, rows, num, nnz, nwin, check_flag],
+                    np.int64,
+                ),
             )
-            peer_info = exchange.gather_headers(step, 6)
+            peer_info = exchange.gather_headers(step, 7)
         failed = [
             i for i, row in enumerate(peer_info) if int(row[0]) == -2
         ]
@@ -1126,6 +1136,45 @@ def _synced_carrier_stream(
             )
         route = _ROUTE_OF_CODE[routes[0]]
         g_rows = _pad_rows_for_scan(int(live[:, 2].max()))
+        # Derived step geometry — the values every process computes
+        # LOCALLY from the gathered (identical) headers: the carrier
+        # bucket on scatter steps, the pow2 panel width on dense ones.
+        # Pure arithmetic on agreed ints, so it runs outside the
+        # payload try; pulled ahead of payload construction so the
+        # collective-check digest can cover it before any payload
+        # bytes move.
+        bucket = 0
+        g_dense = 0
+        payload_num = nums[0]  # the agreed payload dtype (checked above)
+        if route == "scatter":
+            bucket = _carrier_bucket(int(live[:, 1].max()))
+            geometry = (g_rows, bucket, world, n_padded, payload_num)
+        else:
+            g_dense = dense_panel_width(int(live[:, 2].max()), dense_width)
+            geometry = (g_dense, v_div, world, n_padded, payload_num)
+        # Collective-congruence backstop: every LIVE process enabled it
+        # (agreed, from the gathered flag column — a drained process
+        # evaluates the same gathered predicate and participates in the
+        # exchange regardless of its own env, so the decision stays
+        # congruent) → exchange a digest of this step's derived
+        # (op, geometry) sequence and raise on every process together
+        # at the first divergent step.
+        if bool((live[:, 6] == 1).all()):
+            digest = collectivecheck.step_digest(
+                exchange.stream,
+                step,
+                [("header", (world, 7)), (route, geometry)],
+            )
+            with obs.span(
+                "gramian.sparse.allgather",
+                step=step,
+                phase="check",
+                stream=exchange.stream,
+                processes=world,
+            ):
+                exchange.post_check(step, digest)
+                digests = exchange.gather_checks(step)
+            collectivecheck.verify_step_digests(step, digests, digest)
         # Local payload construction is host numpy work (carrier
         # padding, densify/pack) that can fail one-sided — e.g.
         # MemoryError on the densify at biobank widths — AFTER the
@@ -1135,10 +1184,8 @@ def _synced_carrier_stream(
         # discipline, per in-flight slot.
         payload_exc = None
         local = None
-        bucket = 0
         try:
             if route == "scatter":
-                bucket = _carrier_bucket(int(live[:, 1].max()))
                 if gang:
                     gidx = np.concatenate(
                         [idx for idx, _ in gang]
@@ -1157,13 +1204,11 @@ def _synced_carrier_stream(
                 # synthesizes this process's inert all-sentinel block
                 # locally from its −1 header (zero bytes moved).
             else:
-                # Power-of-two panel bucket of the GLOBAL max row
-                # count (identical gathered data on every process ⇒
-                # identical width): tail/small windows no longer pay
-                # the full block width in inert MXU columns.
-                g_dense = dense_panel_width(
-                    int(live[:, 2].max()), dense_width
-                )
+                # g_dense is the pow2 panel bucket of the GLOBAL max
+                # row count (identical gathered data on every process
+                # ⇒ identical width), derived above with the step
+                # geometry: tail/small windows no longer pay the full
+                # block width in inert MXU columns.
                 if gang:
                     xb = _densify_window(
                         gang[0][0], gang[0][1], n_samples, g_dense

@@ -1,0 +1,104 @@
+"""Runtime backstop for SPMD collective congruence (GL010's dynamic twin).
+
+GL010 proves at review time that no lockstep collective sits under a
+branch on host-local state — for in-tree call sites. A static gate
+cannot see version-skewed pods (hosts running different code deriving
+different geometry from the same gathered headers), monkeypatched
+tests, or an embedder driving the protocol directly. With
+``SPARK_EXAMPLES_TPU_COLLECTIVE_CHECK=1`` every pod protocol step
+digests its derived (op, geometry) tuple sequence — the route, the
+padded row count, the agreed carrier bucket or dense panel width, the
+payload dtype — and cross-checks peers over the existing podstream
+exchange (one extra tiny frame per step, nothing on the disabled path).
+A divergent step raises on EVERY process together, naming the step and
+the per-process digests, instead of desyncing the frame protocol or
+deadlocking a device collective minutes later.
+
+Enablement is itself agreed: each process advertises its check flag in
+the step header, and the digest exchange runs only when every live
+process enabled it — a mixed pod degrades to unchecked rather than
+desyncing on unexpected frames (the predicate derives from gathered
+data, exactly the discipline GL010 codifies).
+
+Disabled (the default) this is one env read per protocol step — host
+work on a path already dominated by socket IO.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Sequence, Tuple
+
+__all__ = [
+    "COLLECTIVE_CHECK_ENV",
+    "collective_check_enabled",
+    "note_collective_check",
+    "step_digest",
+    "verify_step_digests",
+]
+
+COLLECTIVE_CHECK_ENV = "SPARK_EXAMPLES_TPU_COLLECTIVE_CHECK"
+
+# (op name, geometry ints) pairs — one per lockstep operation of the
+# step, in issue order.
+OpGeometry = Tuple[str, Tuple[int, ...]]
+
+
+def collective_check_enabled() -> bool:
+    """Read per call (not cached): test fixtures toggle the env var
+    around individual suites."""
+    return os.environ.get(COLLECTIVE_CHECK_ENV, "") not in ("", "0")
+
+
+def step_digest(stream: int, step: int, ops: Sequence[OpGeometry]) -> int:
+    """Order-sensitive 63-bit digest of one protocol step's (op,
+    geometry) sequence. Non-negative always — the exchange reserves
+    negative values for 'check disabled on this process'."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(f"{stream}|{step}".encode())
+    for op, geometry in ops:
+        h.update(b"\x00" + op.encode())
+        for g in geometry:
+            h.update(b"\x01" + str(int(g)).encode())
+    return int.from_bytes(h.digest(), "little") & (2**63 - 1)
+
+
+def note_collective_check(outcome: str) -> None:
+    """Count one cross-checked protocol step: ``agree`` (digests
+    matched on every live process) or ``divergence`` (mismatch — the
+    step raised everywhere). One registration site (GL003); the label
+    set rides ``validate_trace._LABELED_COUNTERS``."""
+    from spark_examples_tpu import obs
+
+    obs.get_registry().counter(
+        "collective_check_steps_total",
+        "Pod protocol steps cross-checked by the collective-congruence "
+        "runtime backstop, by outcome",
+    ).labels(outcome=outcome).inc()
+
+
+def verify_step_digests(
+    step: int, digests: Sequence[int], local_digest: int
+) -> None:
+    """Compare the gathered per-process digests for one step.
+
+    ``digests`` is the (world,)-length gathered vector — every entry is
+    a non-negative digest (the caller only runs the exchange when every
+    live process enabled the check). Raises ``RuntimeError`` on
+    mismatch — from identical gathered data, so every process raises
+    together at the same step.
+    """
+    distinct = sorted({int(d) for d in digests})
+    if len(distinct) <= 1:
+        note_collective_check("agree")
+        return
+    note_collective_check("divergence")
+    per_proc = {i: int(d) for i, d in enumerate(digests)}
+    raise RuntimeError(
+        f"collective-congruence check failed at protocol step {step}: "
+        f"per-process (op, geometry) digests diverged {per_proc} "
+        f"(local {int(local_digest)}) — the pod is issuing different "
+        "collective sequences (version skew, or a geometry derivation "
+        "bug); raising on every process together"
+    )

@@ -43,6 +43,9 @@ SINGLE_FILE_RULES = [
     ("gl007", "lock-discipline", ".py"),
     ("gl008", "deadlock-order", ".py"),
     ("gl009", "guarded-fields", ".py"),
+    ("gl010", "collective-congruence", ".py"),
+    ("gl011", "donation-aliasing", ".py"),
+    ("gl012", "retrace-discipline", ".py"),
 ]
 
 
@@ -194,7 +197,7 @@ class TestRealTreeGate:
         # The deliberate session-root suppression is visible data:
         assert objs[-1]["summary"]["suppressed"].get("span-contract", 0) >= 1
 
-    def test_list_rules_names_all_nine(self):
+    def test_list_rules_names_all_twelve(self):
         proc = subprocess.run(
             [sys.executable, "-m", "tools.graftlint", "--list-rules"],
             capture_output=True,
@@ -212,6 +215,9 @@ class TestRealTreeGate:
             "GL007",
             "GL008",
             "GL009",
+            "GL010",
+            "GL011",
+            "GL012",
         ):
             assert code in proc.stdout
 

@@ -1,0 +1,489 @@
+"""The SPMD dispatch-analysis graftlint layer (GL010-GL012) and its
+runtime backstop.
+
+The golden fixtures in tests/test_graftlint.py prove each rule's
+headline behavior; this file drills the ENGINE pieces whose
+mis-modeling would make the rules silently wrong on exactly the
+protocol code they gate — the taint/agreement classification behind
+GL010, the wrapper-transitivity and loop-rebind handling behind GL011,
+the derivation analysis behind GL012 — plus the docs/CONCURRENCY.md
+collective-order drift gate and the collectivecheck digest unit
+behavior."""
+
+import json
+import os
+import re
+import textwrap
+
+from tools.graftlint.engine import Project, load_config, run_lint
+from tools.graftlint.rules.collective_congruence import collective_order
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mini(tmp_path, rule_name, files):
+    """One-rule project over inline sources (the test_graftlint_flow
+    harness, reused for the SPMD rules)."""
+    from tools.graftlint.rules import ALL_RULES
+
+    lines = ["[tool.graftlint]", "exclude = []"]
+    for r in ALL_RULES:
+        lines.append(f'[tool.graftlint.rules."{r.name}"]')
+        lines.append(
+            f"enabled = {'true' if r.name == rule_name else 'false'}"
+        )
+        if r.name == rule_name:
+            lines.append('paths = ["."]')
+    (tmp_path / "pyproject.toml").write_text("\n".join(lines) + "\n")
+    for name, src in files.items():
+        (tmp_path / name).write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+class TestCollectiveCongruence:
+    def test_agreed_predicate_from_gather_is_clean(self, tmp_path):
+        """The all-raise-together protocol shape: a raise governed by
+        gathered data ahead of later collectives is sanctioned."""
+        root = _mini(
+            tmp_path,
+            "collective-congruence",
+            {
+                "m.py": """
+                import numpy as np
+
+                def step(exchange, step, windows):
+                    gang = next(windows, None)
+                    code = -1 if gang is None else 0
+                    exchange.post_header(step, np.array([code]))
+                    peers = exchange.gather_headers(step, 1)
+                    live = peers[peers[:, 0] >= 0]
+                    if live.size == 0:
+                        return None
+                    exchange.post_confirm(step, True)
+                    return exchange.gather_confirms(step)
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert findings == []
+
+    def test_tainted_terminal_branch_governs_later_collectives(
+        self, tmp_path
+    ):
+        """One process returning on its local stream state while peers
+        proceed into the gather is THE one-sided deadlock."""
+        root = _mini(
+            tmp_path,
+            "collective-congruence",
+            {
+                "m.py": """
+                import numpy as np
+
+                def step(exchange, step, windows):
+                    gang = next(windows, None)
+                    if gang is None:
+                        return None
+                    exchange.post_header(step, np.asarray(gang))
+                    return exchange.gather_headers(step, 1)
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert len(findings) == 2  # post_header AND gather_headers
+        assert all("host-local state" in f.message for f in findings)
+
+    def test_stream_loop_governs_collectives(self, tmp_path):
+        root = _mini(
+            tmp_path,
+            "collective-congruence",
+            {
+                "m.py": """
+                from jax.experimental import multihost_utils
+
+                def per_block(blocks):
+                    for xb in blocks:
+                        multihost_utils.process_allgather(xb)
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert len(findings) == 1
+
+    def test_enumerate_does_not_launder_a_stream(self, tmp_path):
+        """Wrapping a per-process stream in enumerate()/sorted() must
+        not make its iteration look agreed — the length still
+        diverges across processes."""
+        root = _mini(
+            tmp_path,
+            "collective-congruence",
+            {
+                "m.py": """
+                from jax.experimental import multihost_utils
+
+                def per_block(blocks):
+                    for i, xb in enumerate(blocks):
+                        multihost_utils.process_allgather(xb)
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert len(findings) == 1
+
+    def test_enumerate_over_gathered_data_is_clean(self, tmp_path):
+        """enumerate over agreement-derived data (the `for i, row in
+        enumerate(peers)` protocol idiom) stays sanctioned."""
+        root = _mini(
+            tmp_path,
+            "collective-congruence",
+            {
+                "m.py": """
+                import numpy as np
+                from jax.experimental import multihost_utils
+
+                def step(exchange, step, payload):
+                    peers = exchange.gather_headers(step, 1)
+                    live = peers[peers[:, 0] >= 0]
+                    for i, row in enumerate(live):
+                        payload = multihost_utils.process_allgather(
+                            payload
+                        )
+                    return payload
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert findings == []
+
+    def test_bounded_range_loop_is_clean(self, tmp_path):
+        root = _mini(
+            tmp_path,
+            "collective-congruence",
+            {
+                "m.py": """
+                from jax.experimental import multihost_utils
+
+                def rounds(g, total_rounds):
+                    for _ in range(total_rounds):
+                        g = multihost_utils.process_allgather(g)
+                    return g
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert findings == []
+
+    def test_exception_variable_taints_derived_names(self, tmp_path):
+        """`except E as e: flag = e` then branching into a collective
+        on `flag` is the raise-on-one-process shape."""
+        root = _mini(
+            tmp_path,
+            "collective-congruence",
+            {
+                "m.py": """
+                import jax
+
+                def risky(x, source):
+                    failed = None
+                    try:
+                        payload = source.build(x)
+                    except ValueError as e:
+                        failed = e
+                    if failed is None:
+                        x = jax.lax.psum(x, "data")
+                    return x
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert len(findings) == 1
+        assert "psum" in findings[0].message
+
+    def test_collective_in_lax_cond_named_branch(self, tmp_path):
+        """Named local functions referenced by lax.cond are inspected,
+        not just lambdas."""
+        root = _mini(
+            tmp_path,
+            "collective-congruence",
+            {
+                "m.py": """
+                import jax
+
+                def tile(g, flag):
+                    def _with_sum(v):
+                        return jax.lax.psum(v, "data")
+
+                    def _skip(v):
+                        return v
+
+                    return jax.lax.cond(flag, _with_sum, _skip, g)
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert len(findings) == 1
+        assert "lax.cond" in findings[0].message
+
+
+class TestDonationAliasing:
+    def test_wrapper_transitivity_gates_wrapper_call_sites(self, tmp_path):
+        """gramian_accumulate-style wrappers: the plain function
+        forwarding into a donated position donates its own parameter,
+        so ITS call sites are checked."""
+        root = _mini(
+            tmp_path,
+            "donation-aliasing",
+            {
+                "m.py": """
+                from functools import partial
+                import jax
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def _accum_jit(g, xb):
+                    return g + xb
+
+                def accumulate(g, xb):
+                    return _accum_jit(g, xb)
+
+                def caller(g, xb):
+                    g2 = accumulate(g, xb)
+                    return g + g2  # g donated through the wrapper
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert len(findings) == 1
+        assert "read after" in findings[0].message
+
+    def test_loop_rebind_is_safe_non_rebind_is_not(self, tmp_path):
+        root = _mini(
+            tmp_path,
+            "donation-aliasing",
+            {
+                "m.py": """
+                from functools import partial
+                import jax
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def _accum_jit(g, xb):
+                    return g + xb
+
+                def good(g, blocks):
+                    for xb in blocks:
+                        g = _accum_jit(g, xb)
+                    return g
+
+                def bad(g, blocks, sink):
+                    for xb in blocks:
+                        out = _accum_jit(g, xb)
+                        sink.append(g)  # next iteration reads dead g
+                    return out
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert len(findings) == 1
+        assert findings[0].line  # attributed to the bad call site
+
+    def test_attribute_donation_names_other_accessors(self, tmp_path):
+        root = _mini(
+            tmp_path,
+            "donation-aliasing",
+            {
+                "m.py": """
+                from functools import partial
+                import jax
+                import jax.numpy as jnp
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def _accum_jit(g, xb):
+                    return g + xb
+
+                class Tier:
+                    def __init__(self):
+                        self._g = jnp.zeros((4, 4))
+
+                    def step(self, xb):
+                        return _accum_jit(self._g, xb)
+
+                    def snapshot(self):
+                        return self._g
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert len(findings) == 1
+        assert "stored attribute" in findings[0].message
+        assert "Tier.snapshot" in findings[0].message
+
+
+class TestRetraceDiscipline:
+    def test_jit_assignment_form_with_keyword_static(self, tmp_path):
+        """`scatter = jax.jit(f, donate..., static_argnames=...)`
+        assignment forms gate keyword-passed geometry statics."""
+        root = _mini(
+            tmp_path,
+            "retrace-discipline",
+            {
+                "m.py": """
+                import jax
+
+                def _impl(x, n_rows):
+                    return x[:n_rows]
+
+                scatter = jax.jit(_impl, static_argnames=("n_rows",))
+
+                def run(x, windows):
+                    out = []
+                    for idx, lens in windows:
+                        out.append(scatter(x, n_rows=int(lens.size)))
+                    return out
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert len(findings) == 1
+        assert "n_rows" in findings[0].message
+
+    def test_shape_of_same_call_operand_is_blessed(self, tmp_path):
+        """n_bits = 8 * xp.shape[1] where xp rides the same call: the
+        operand's shape is already part of the executable key."""
+        root = _mini(
+            tmp_path,
+            "retrace-discipline",
+            {
+                "m.py": """
+                from functools import partial
+                import jax
+
+                @partial(jax.jit, static_argnames=("n_bits",))
+                def _unpack_jit(g, xp, n_bits):
+                    return g, xp, n_bits
+
+                def accumulate(g, xp):
+                    return _unpack_jit(g, xp, 8 * xp.shape[1])
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert findings == []
+
+    def test_helper_call_blesses_raw_interior(self, tmp_path):
+        """The bucket helper IS the blessing: raw stream geometry
+        inside its arguments is exactly the sanctioned shape."""
+        root = _mini(
+            tmp_path,
+            "retrace-discipline",
+            {
+                "m.py": """
+                from functools import partial
+                import jax
+
+                @partial(jax.jit, static_argnames=("width",))
+                def _panel_jit(x, width):
+                    return x[:, :width]
+
+                def run(x, windows, block_variants):
+                    out = []
+                    for idx, lens in windows:
+                        out.append(
+                            _panel_jit(
+                                x,
+                                dense_panel_width(
+                                    int(lens.size), block_variants
+                                ),
+                            )
+                        )
+                    return out
+                """
+            },
+        )
+        findings, _ = run_lint(root, [])
+        assert findings == []
+
+
+class TestCollectiveOrderDrift:
+    """docs/CONCURRENCY.md embeds the GL010-derived per-function
+    collective sequences as JSON; the doc and the derivation must never
+    disagree (the GL008 lock-graph discipline applied to the SPMD
+    dispatch surface)."""
+
+    def _doc_order(self):
+        path = os.path.join(REPO_ROOT, "docs", "CONCURRENCY.md")
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        section = text.split("## The collective order", 1)
+        assert len(section) == 2, (
+            "docs/CONCURRENCY.md lost its collective-order section"
+        )
+        m = re.search(r"```json\n(.*?)```", section[1], re.S)
+        assert m, "collective-order section lost its JSON block"
+        return json.loads(m.group(1))
+
+    def test_documented_order_matches_derivation(self):
+        derived = collective_order(
+            Project(REPO_ROOT, load_config(REPO_ROOT))
+        )
+        assert self._doc_order() == derived, (
+            "docs/CONCURRENCY.md and the GL010 derivation diverged — "
+            "re-run `python -m tools.graftlint --collective-order` and "
+            "update the doc in the same PR"
+        )
+
+    def test_pod_protocol_sequence_is_present(self):
+        """The pod-sparse per-window protocol must appear with its full
+        header→check→confirm order — losing it from the derivation
+        would mean GL010 stopped seeing the protocol at all."""
+        derived = collective_order(
+            Project(REPO_ROOT, load_config(REPO_ROOT))
+        )
+        key = (
+            "spark_examples_tpu/parallel/sharded.py::"
+            "_synced_carrier_stream._produce_step"
+        )
+        assert derived[key] == [
+            "post_header",
+            "gather_headers",
+            "post_check",
+            "gather_checks",
+            "post_confirm",
+            "gather_confirms",
+        ]
+
+
+class TestCollectiveCheckBackstop:
+    def test_digest_is_order_sensitive_and_nonnegative(self):
+        from spark_examples_tpu.utils import collectivecheck as cc
+
+        a = cc.step_digest(1, 0, [("scatter", (256, 8)), ("psum", (4,))])
+        b = cc.step_digest(1, 0, [("psum", (4,)), ("scatter", (256, 8))])
+        assert a != b
+        assert a >= 0 and b >= 0
+        # Deterministic across calls (peers must derive the same value).
+        assert a == cc.step_digest(
+            1, 0, [("scatter", (256, 8)), ("psum", (4,))]
+        )
+        # Step identity is part of the digest.
+        assert a != cc.step_digest(
+            1, 1, [("scatter", (256, 8)), ("psum", (4,))]
+        )
+
+    def test_verify_raises_on_divergence_with_step(self):
+        import pytest
+
+        from spark_examples_tpu.utils import collectivecheck as cc
+
+        cc.verify_step_digests(3, [7, 7, 7], 7)  # agree: no raise
+        with pytest.raises(RuntimeError) as ei:
+            cc.verify_step_digests(5, [7, 8, 7], 7)
+        assert "protocol step 5" in str(ei.value)
+        assert "digests diverged" in str(ei.value)
+
+    def test_enabled_reads_env_per_call(self, monkeypatch):
+        from spark_examples_tpu.utils import collectivecheck as cc
+
+        monkeypatch.delenv(cc.COLLECTIVE_CHECK_ENV, raising=False)
+        assert not cc.collective_check_enabled()
+        monkeypatch.setenv(cc.COLLECTIVE_CHECK_ENV, "1")
+        assert cc.collective_check_enabled()
+        monkeypatch.setenv(cc.COLLECTIVE_CHECK_ENV, "0")
+        assert not cc.collective_check_enabled()

@@ -705,6 +705,11 @@ def _run_pod_workers(script_path, argv, n=2, timeout=300):
         "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
         "JAX_NUM_PROCESSES": str(n),
         "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        # The collective-congruence runtime backstop runs for the whole
+        # multiprocess suite: every protocol step cross-checks its
+        # derived (op, geometry) digest across peers, so a divergence
+        # bug fails loudly here instead of deadlocking a real pod.
+        "SPARK_EXAMPLES_TPU_COLLECTIVE_CHECK": "1",
     }
     procs = [
         subprocess.Popen(
@@ -1046,6 +1051,91 @@ _POD_CHAOS_WORKER = textwrap.dedent(
 )
 
 
+_POD_COLLECTIVE_CHECK_WORKER = textwrap.dedent(
+    """
+    import json, os, re, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from spark_examples_tpu.parallel.distributed import initialize_from_env
+    assert initialize_from_env()
+    from spark_examples_tpu.parallel.sharded import (
+        sparse_sharded_gramian_blockwise,
+    )
+    from spark_examples_tpu.utils import collectivecheck
+    from spark_examples_tpu import obs
+
+    pid, world = jax.process_index(), jax.process_count()
+    mesh = Mesh(np.array(jax.devices()).reshape(world, 2), ("data", "model"))
+    results = {}
+    assert collectivecheck.collective_check_enabled()  # harness env
+
+    counter = obs.get_registry().counter(
+        "collective_check_steps_total",
+        "Pod protocol steps cross-checked by the collective-congruence "
+        "runtime backstop, by outcome",
+    )
+
+    def win(i):
+        return np.asarray([i % 9], np.int64), np.asarray([1], np.int64)
+
+    # A. Clean run with the backstop ON: bit-identical G, every live
+    # step cross-checked and counted as agree.
+    before = counter.labels(outcome="agree").value
+    g = sparse_sharded_gramian_blockwise(
+        iter([win(i) for i in range(4)]), 9, mesh,
+        density_threshold=1.01, pipeline_depth=2, coalesce_variants=0,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P(None, None))
+    results["g"] = np.asarray(
+        jax.jit(lambda a: a, out_shardings=rep)(g)
+    ).tolist()
+    results["agree_clean"] = counter.labels(outcome="agree").value - before
+
+    # B. Chaos: a one-sided extra/omitted collective, injected through
+    # the fault seam on the podstream step hash — process 0's digest
+    # diverges at FAULT_STEP. The backstop must raise on EVERY process
+    # at the SAME step (never a stranded peer).
+    FAULT_STEP = 1
+    real_digest = collectivecheck.step_digest
+
+    def faulty(stream, step, ops):
+        d = real_digest(stream, step, ops)
+        if pid == 0 and step == FAULT_STEP:
+            # Simulate an extra collective in the derived sequence.
+            d = real_digest(stream, step, list(ops) + [("psum", (9,))])
+        return d
+
+    collectivecheck.step_digest = faulty
+    try:
+        sparse_sharded_gramian_blockwise(
+            iter([win(i) for i in range(6)]), 9, mesh,
+            density_threshold=1.01, pipeline_depth=2,
+            coalesce_variants=0,
+        )
+        results["raised"] = False
+    except RuntimeError as e:
+        msg = str(e)
+        m = re.search(r"protocol step (\\d+)", msg)
+        results["raised"] = (
+            "collective-congruence check failed" in msg
+            and "digests diverged" in msg
+        )
+        results["step"] = int(m.group(1)) if m else -1
+    finally:
+        collectivecheck.step_digest = real_digest
+    results["divergence"] = counter.labels(outcome="divergence").value
+
+    with open(sys.argv[1] + f".{pid}", "w") as f:
+        json.dump(results, f)
+    """
+)
+
+
 @pod_skip
 class TestPodSparseProtocol:
     """The per-step carrier-allgather protocol on a REAL ≥2-process
@@ -1144,6 +1234,37 @@ class TestPodSparseProtocol:
             assert all(r["divergence"]), r
             assert all(r["payload"]), r
             assert r["outcomes"] == r["expected"], r
+
+    @pytest.mark.parametrize("nprocs", [2, 4])
+    def test_collective_check_divergence_chaos(self, tmp_path, nprocs):
+        """The SPARK_EXAMPLES_TPU_COLLECTIVE_CHECK=1 backstop: a
+        one-sided extra collective (injected through the fault seam on
+        the podstream step hash) raises on EVERY process at the SAME
+        step, while a clean run stays bit-identical with every live
+        step counted as agree."""
+        if nprocs > (os.cpu_count() or 1) * 4:
+            pytest.skip("not enough cores to host the pod-sim")
+        script = tmp_path / "worker.py"
+        script.write_text(_POD_COLLECTIVE_CHECK_WORKER)
+        out_file = tmp_path / "result.json"
+        _run_pod_workers(script, [out_file], n=nprocs, timeout=240)
+
+        # The clean phase's G equals the dense reference over the
+        # union of every process's windows (each process scatters
+        # win(0..3): +1 on the diagonal at 0..3 per process).
+        want = np.zeros((9, 9), np.float32)
+        for i in range(4):
+            want[i % 9, i % 9] += nprocs
+        steps = set()
+        for pid in range(nprocs):
+            r = json.loads((tmp_path / f"result.json.{pid}").read_text())
+            np.testing.assert_array_equal(np.asarray(r["g"]), want)
+            assert r["agree_clean"] == 4, r
+            assert r["raised"] is True, r
+            assert r["divergence"] >= 1, r
+            steps.add(r["step"])
+        # ... and the raise landed at the SAME step everywhere.
+        assert steps == {1}, steps
 
 
 @pytest.mark.slow

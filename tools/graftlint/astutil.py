@@ -8,11 +8,19 @@ from typing import Iterator, List, Optional
 __all__ = [
     "dotted_name",
     "call_name",
+    "last_component",
     "walk_calls",
     "is_jit_decorator",
     "jitted_functions",
     "literal_str",
 ]
+
+
+def last_component(name: Optional[str]) -> Optional[str]:
+    """Final segment of a dotted name (``psum`` for ``jax.lax.psum``);
+    passes None through — the match-by-last-component idiom the SPMD
+    rules share."""
+    return name.rsplit(".", 1)[-1] if name else None
 
 
 def dotted_name(node: ast.AST) -> Optional[str]:

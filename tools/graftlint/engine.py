@@ -370,6 +370,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "JSON and exit (the exact payload docs/CONCURRENCY.md embeds "
         "and the drift test pins)",
     )
+    parser.add_argument(
+        "--collective-order",
+        action="store_true",
+        help="Print the GL010-derived per-function lockstep collective "
+        "sequences as JSON and exit (the exact payload "
+        "docs/CONCURRENCY.md embeds and the drift test pins)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -383,6 +390,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         root = args.root or find_root(os.getcwd())
         project = Project(root, load_config(root))
         print(json.dumps(lock_graph(project), indent=2, sort_keys=True))
+        return 0
+
+    if args.collective_order:
+        from tools.graftlint.rules.collective_congruence import (
+            collective_order,
+        )
+
+        root = args.root or find_root(os.getcwd())
+        project = Project(root, load_config(root))
+        print(
+            json.dumps(collective_order(project), indent=2, sort_keys=True)
+        )
         return 0
 
     root = args.root or find_root(os.getcwd())

@@ -1,0 +1,57 @@
+"""Golden negative for GL010 collective-congruence: the sanctioned
+protocol shapes — unconditional collectives, predicates derived from
+prior agreement steps, failure codes riding the next exchange."""
+
+import numpy as np
+from jax.experimental import multihost_utils
+
+
+def synced_step(windows, exchange, step, world):
+    """The all-raise-together protocol shape: host-local failures are
+    encoded into the header; every later predicate reads gathered
+    (agreed) data."""
+    exc = None
+    try:
+        gang = next(windows, None)
+    except Exception as e:  # noqa: BLE001 — synced below
+        exc, gang = e, None
+    if exc is not None:
+        code = -2
+    elif gang is None:
+        code = -1
+    else:
+        code = 0
+    exchange.post_header(step, np.array([code], np.int64))
+    peers = exchange.gather_headers(step, 1)
+    failed = [i for i, row in enumerate(peers) if int(row[0]) == -2]
+    if failed:
+        # Agreed predicate: every process raises together.
+        raise RuntimeError(f"failed on {failed}") from exc
+    live = peers[peers[:, 0] >= 0]
+    if live.size == 0:
+        return None  # agreed: every stream drained everywhere
+    exchange.post_confirm(step, True)
+    return exchange.gather_confirms(step)
+
+
+def config_gated_sync(blocks, mesh, spans_processes):
+    """Collectives under parameter (config-contract) predicates are
+    congruent: every process calls with the same arguments."""
+    first = next(iter(blocks), None)
+    local = -1 if first is None else int(np.asarray(first).shape[1])
+    if spans_processes:
+        widths = np.asarray(
+            multihost_utils.process_allgather(
+                np.array([local], np.int64)
+            )
+        ).ravel()
+        live = sorted({int(w) for w in widths if w >= 0})
+        if len(live) > 1:
+            raise ValueError(f"widths diverged: {live}")
+    return first
+
+
+def bounded_rounds(g, total_rounds):
+    for _ in range(total_rounds):  # agreed bound: congruent iteration
+        g = multihost_utils.process_allgather(g)
+    return g

@@ -1,0 +1,50 @@
+"""Golden positive for GL010 collective-congruence: lockstep
+collectives governed by host-local state — each shape deadlocks or
+strands peers on a real pod."""
+
+import jax
+import numpy as np
+from jax.experimental import multihost_utils
+
+
+def drained_stream_skips_header(windows, exchange, step):
+    gang = next(windows, None)  # host-local stream data
+    if gang is None:
+        return None  # one process exits here...
+    # ...while peers with live streams block in the gather forever.
+    exchange.post_header(step, np.asarray(gang, np.int64))
+    return exchange.gather_headers(step, 1)
+
+
+def collective_in_handler(x):
+    try:
+        x = x * 2
+    except ValueError:
+        # Peers that did not raise never reach this psum.
+        x = jax.lax.psum(x, "data")
+    return x
+
+
+def per_window_allgather(stream):
+    out = []
+    for window in stream:  # per-process stream: trip counts diverge
+        out.append(
+            multihost_utils.process_allgather(np.asarray(window))
+        )
+    return out
+
+
+def collective_under_traced_branch(x, flag):
+    # The traced predicate selects the branch per DEVICE.
+    return jax.lax.cond(
+        flag,
+        lambda v: jax.lax.psum(v, "data"),
+        lambda v: v,
+        x,
+    )
+
+
+def one_sided_rank_branch(x):
+    if jax.process_index() == 0:  # host-local by definition
+        x = jax.lax.all_gather(x, "data")
+    return x

@@ -1,0 +1,11 @@
+"""Golden pragma-suppressed case for GL010 collective-congruence."""
+
+import jax
+
+
+def single_process_only(x, flag_from_local_probe):
+    # Sound only because this path is gated to process_count() == 1
+    # by the caller; the pragma records the debt.
+    if flag_from_local_probe and jax.process_index() == 0:
+        x = jax.lax.psum(x, "data")  # graftlint: disable=collective-congruence
+    return x

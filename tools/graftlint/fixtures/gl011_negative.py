@@ -1,0 +1,38 @@
+"""Golden negative for GL011 donation-aliasing: the blessed shapes —
+rebinding through the donating call, explicit copies before any alias
+escapes, views of the RESULT."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _accum(g, xb):
+    return g + xb @ xb.T
+
+
+def accumulate(blocks, n):
+    """The accumulator-loop idiom: rebind through the call — the next
+    iteration (and the final read) sees the FRESH buffer."""
+    g = jnp.zeros((n, n), dtype=jnp.float32)
+    for xb in blocks:
+        g = _accum(g, xb)
+    return np.asarray(g)  # view of the final result: never donated again
+
+
+def copy_before_store(cache, g, xb):
+    # The DeltaEntry discipline: an explicit self-owned copy, then the
+    # donating dispatch — nothing aliases the donated buffer.
+    cache.entry = np.array(g, copy=True)
+    g = _accum(g, xb)
+    return g
+
+
+def forwarding_wrapper(g, xb):
+    """Public donating entry point: the parameter forwards into the
+    donated position and is never read again here — its own call sites
+    carry the contract."""
+    return _accum(g, xb)

@@ -1,0 +1,50 @@
+"""Golden positive for GL011 donation-aliasing: live host aliases of
+donated device buffers — every shape reads recycled memory."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _accum(g, xb):
+    return g + xb @ xb.T
+
+
+class CachingServer:
+    def __init__(self):
+        self._g = jnp.zeros((4, 4))
+        self._snapshot = None
+
+    def step(self, xb):
+        # Donating a stored attribute: every other method still holds
+        # a reference to the DEAD buffer.
+        out = _accum(self._g, xb)
+        return out
+
+    def read(self):
+        return self._g
+
+
+def donated_view(g, xb):
+    # Donating a subscript view: the base stays live in the caller.
+    return _accum(g[:4, :4], xb)
+
+
+def snapshot_dies(g, xb):
+    snapshot = np.asarray(g)  # zero-copy view of the device buffer
+    g = _accum(g, xb)
+    return snapshot  # reads recycled memory after the donation
+
+
+def use_after_donation(g, xb):
+    g2 = _accum(g, xb)
+    return g2 + g  # `g` was donated; this read is a dead-buffer read
+
+
+def stored_view_then_donated(cache, g, xb):
+    cache.entry = np.asarray(g)  # stored zero-copy view...
+    g2 = _accum(g, xb)  # ...dies when g is donated here
+    return g2

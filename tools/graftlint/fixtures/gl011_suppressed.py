@@ -1,0 +1,20 @@
+"""Golden pragma-suppressed case for GL011 donation-aliasing."""
+
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _accum(g, xb):
+    return g + xb @ xb.T
+
+
+def debug_probe(g, xb):
+    # Sound only on the CPU interpret path where the harness pins the
+    # buffer; the pragma records the debt.
+    snap = np.asarray(g)
+    g = _accum(g, xb)  # graftlint: disable=donation-aliasing
+    print(snap.sum())  # graftlint: disable=donation-aliasing
+    return g

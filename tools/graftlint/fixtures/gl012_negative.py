@@ -1,0 +1,69 @@
+"""Golden negative for GL012 retrace-discipline: geometry routed
+through the registered bucket helpers, parameters, and constants —
+executable counts stay O(log N)."""
+
+from functools import lru_cache, partial
+
+import jax
+
+_DEF_WIDTH = 64
+
+
+@partial(jax.jit, static_argnames=("width",))
+def _panel_jit(x, width):
+    return x[:, :width]
+
+
+@lru_cache(maxsize=8)
+def _tile_kernels(n_padded, tile_rows, path):
+    return (n_padded, tile_rows, path)
+
+
+def bucketed_windows(x, windows, block_variants):
+    out = []
+    for idx, lens in windows:
+        # The pow2-panel discipline: per-window geometry rounds through
+        # the registered bucket helper.
+        out.append(_panel_jit(x, dense_panel_width(int(lens.size), block_variants)))
+    return out
+
+
+def param_geometry(x, n_samples, mesh):
+    # Parameters and mesh config are the caller's contract; constants
+    # are compile-time geometry.
+    n_padded = round_up_multiple(n_samples, mesh.shape["data"])
+    tile_rows = n_padded // mesh.shape["data"]
+    _tile_kernels(n_padded, tile_rows, "scan")
+    return _panel_jit(x, _DEF_WIDTH)
+
+
+def bucketed_carrier(window_idx, lens, n_padded):
+    return padded_carrier_matrix(
+        window_idx,
+        lens,
+        sentinel=n_padded,
+        n_rows=_pad_rows_for_scan(int(lens.size)),
+        k_bucket=_carrier_bucket(int(lens.max())),
+    )
+
+
+def dense_panel_width(rows, block_variants):
+    return max(rows, block_variants)
+
+
+def round_up_multiple(n, m):
+    return ((n + m - 1) // m) * m
+
+
+def _pad_rows_for_scan(rows):
+    return max(rows, 256)
+
+
+def _carrier_bucket(k):
+    return max(k, 8)
+
+
+def padded_carrier_matrix(
+    window_idx, lens, sentinel, n_rows=None, k_bucket=None
+):
+    return (window_idx, lens, sentinel, n_rows, k_bucket)

@@ -1,0 +1,51 @@
+"""Golden positive for GL012 retrace-discipline: raw per-window
+geometry reaching executable-keyed arguments — every distinct value
+mints a fresh XLA executable."""
+
+from functools import lru_cache, partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("width",))
+def _panel_jit(x, width):
+    return x[:, :width]
+
+
+@lru_cache(maxsize=8)
+def _tile_kernels(n_padded, tile_rows, path):
+    return (n_padded, tile_rows, path)
+
+
+def per_window_static(x, windows):
+    out = []
+    for idx, lens in windows:
+        # Raw per-window variant count as a static arg: one compile
+        # per distinct window size.
+        out.append(_panel_jit(x, int(lens.size)))
+    return out
+
+
+def raw_factory_geometry(windows):
+    kernels = []
+    for idx, lens in windows:
+        # Executable-cache factory keyed on unrounded stream geometry.
+        kernels.append(_tile_kernels(int(lens.size), 4, "scan"))
+    return kernels
+
+
+def raw_carrier_rows(idx, windows, n_padded):
+    mats = []
+    for window_idx, lens in windows:
+        # Shape-bearing helper fed unbucketed rows: the scatter
+        # executable re-traces per window.
+        mats.append(
+            padded_carrier_matrix(
+                window_idx, lens, sentinel=n_padded, n_rows=lens.size
+            )
+        )
+    return mats
+
+
+def padded_carrier_matrix(window_idx, lens, sentinel, n_rows=None):
+    return (window_idx, lens, sentinel, n_rows)

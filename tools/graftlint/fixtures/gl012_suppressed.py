@@ -1,0 +1,17 @@
+"""Golden pragma-suppressed case for GL012 retrace-discipline."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("width",))
+def _panel_jit(x, width):
+    return x[:, :width]
+
+
+def one_shot_probe(x, windows):
+    # Sound only because this probe runs ONCE per process at startup;
+    # the pragma records the debt.
+    idx, lens = next(iter(windows))
+    return _panel_jit(x, int(lens.size))  # graftlint: disable=retrace-discipline

@@ -6,7 +6,11 @@ their full configured scope regardless of CLI path narrowing), and
 ``check(project) -> Iterable[Finding]``.
 """
 
+from tools.graftlint.rules.collective_congruence import (
+    RULE as COLLECTIVE_CONGRUENCE,
+)
 from tools.graftlint.rules.deadlock_order import RULE as DEADLOCK_ORDER
+from tools.graftlint.rules.donation_aliasing import RULE as DONATION_ALIASING
 from tools.graftlint.rules.dtype_discipline import RULE as DTYPE_DISCIPLINE
 from tools.graftlint.rules.flag_registry import RULE as FLAG_REGISTRY
 from tools.graftlint.rules.guarded_fields import RULE as GUARDED_FIELDS
@@ -14,6 +18,9 @@ from tools.graftlint.rules.jit_purity import RULE as JIT_PURITY
 from tools.graftlint.rules.lock_discipline import RULE as LOCK_DISCIPLINE
 from tools.graftlint.rules.native_gil import RULE as NATIVE_GIL
 from tools.graftlint.rules.resilience_routing import RULE as RESILIENCE_ROUTING
+from tools.graftlint.rules.retrace_discipline import (
+    RULE as RETRACE_DISCIPLINE,
+)
 from tools.graftlint.rules.span_contract import RULE as SPAN_CONTRACT
 
 ALL_RULES = [
@@ -26,6 +33,9 @@ ALL_RULES = [
     LOCK_DISCIPLINE,
     DEADLOCK_ORDER,
     GUARDED_FIELDS,
+    COLLECTIVE_CONGRUENCE,
+    DONATION_ALIASING,
+    RETRACE_DISCIPLINE,
 ]
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,580 @@
+"""GL010 collective-congruence: every process issues the same collectives.
+
+The pod-sparse engine (PRs 10-12) lives and dies by one SPMD rule:
+every process of a pod must issue the same lockstep operations in the
+same order. A collective (device `psum`/`all_gather`/`ppermute`, a host
+`process_allgather`, or a podstream header/confirm exchange whose
+gather blocks on every peer's post) that one process skips — because
+its local stream drained, its ingest raised, or a per-process config
+differed — strands every peer in that collective forever (or, on real
+hardware, segfaults the pod). The protocol modules defend this at
+runtime with the all-raise-together discipline: host-local failures are
+encoded into the NEXT agreement step (width −1/−2 codes, the
+payload-confirm exchange) so the raise lands on every process from
+identical gathered data. This rule proves the structural half at review
+time:
+
+1. **host-local branch governance** — a lockstep collective may not be
+   governed by a predicate derived from host-local state. Per function,
+   a taint pass classifies every name: *host-local* values are stream
+   data (``next(...)`` results, ``for`` targets over non-``range``
+   iterables), caught exceptions, and ``jax.process_index()``/
+   ``local_devices()``; *agreed* values are constants, function
+   parameters (the cross-process config contract every protocol entry
+   documents), free/module names, and — the heart of the protocol —
+   anything derived from a prior agreement step
+   (``gather_headers``/``gather_confirms``/``process_allgather``
+   results). A collective inside an ``if``/``while`` on a tainted test,
+   or lexically after a tainted branch that can ``return``/``raise``/
+   ``break``/``continue`` (one side exits, the other proceeds into the
+   collective), is a finding.
+2. **except-handler collectives** — a lockstep collective inside an
+   ``except`` body is governed by a local exception by definition: the
+   peers did not take that handler. Always a finding.
+3. **traced-branch collectives** — a collective inside a
+   ``jax.lax.cond``/``lax.switch`` branch callable executes only on
+   devices where the traced predicate selects that branch — the classic
+   SPMD deadlock. The pod dense program keeps its ``all_gather``
+   unconditional for exactly this reason (``_tile_dense_pod``).
+
+The derived per-function collective order is the machine-readable
+protocol sequence: ``python -m tools.graftlint --collective-order``
+emits it as JSON, ``docs/CONCURRENCY.md`` embeds it verbatim, and a
+drift test pins doc to derivation — the GL008 lock-graph discipline
+applied to the SPMD dispatch surface.
+
+Point-to-point payload moves (``post_payload``/``get_payload``/raw
+``post``/``recv``) are deliberately NOT in the lockstep set: the framed
+exchange consumes them according to the agreed headers (a drained
+peer's payload is synthesized locally), and the runtime frame check
+plus the ``SPARK_EXAMPLES_TPU_COLLECTIVE_CHECK=1`` backstop own that
+half of the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import (
+    Any,
+    Dict,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
+
+from tools.graftlint.astutil import dotted_name, last_component
+from tools.graftlint.engine import Finding, Project
+
+NAME = "collective-congruence"
+CODE = "GL010"
+
+DEFAULT_PATHS = (
+    "spark_examples_tpu/parallel",
+    "spark_examples_tpu/ops",
+)
+
+# Lockstep operations: every process must reach these together. The
+# last dotted component is matched, so `jax.lax.psum`, `lax.psum` and
+# a bare `psum` all count.
+LOCKSTEP_OPS = frozenset(
+    {
+        # device collectives
+        "psum",
+        "psum_scatter",
+        "all_gather",
+        "all_to_all",
+        "ppermute",
+        "pmean",
+        "pmax",
+        "pmin",
+        # host-side agreement collectives
+        "process_allgather",
+        "sync_global_devices",
+        # podstream lockstep steps: every peer's gather blocks on every
+        # peer's post, so posts are as congruence-critical as gathers.
+        "post_header",
+        "gather_headers",
+        "post_confirm",
+        "gather_confirms",
+        "post_check",
+        "gather_checks",
+    }
+)
+
+# Results of these calls are agreement values: identical on every
+# process by protocol construction — predicates derived from them are
+# congruent branches, the sanctioned way to make a collective
+# conditional.
+AGREEMENT_SOURCES = frozenset(
+    {
+        "process_allgather",
+        "gather_headers",
+        "gather_confirms",
+        "gather_checks",
+    }
+)
+
+# Always host-local, whatever their arguments.
+_TAINT_CALLS = frozenset({"next", "process_index", "local_devices"})
+
+# Traced-branch primitives whose callables run per-device.
+_TRACED_BRANCH_CALLS = frozenset({"cond", "switch"})
+
+
+def _call_last(call: ast.Call) -> Optional[str]:
+    return last_component(dotted_name(call.func))
+
+
+def _iter_own_statements(body: Sequence[ast.stmt]) -> Iterator[ast.stmt]:
+    """Statements of a function body, recursing into compound bodies
+    but never into nested def/class/lambda (other call stacks)."""
+    for stmt in body:
+        if isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        yield stmt
+        for field in ("body", "orelse", "finalbody"):
+            inner = getattr(stmt, field, None)
+            if inner:
+                yield from _iter_own_statements(inner)
+        for handler in getattr(stmt, "handlers", ()):
+            yield from _iter_own_statements(handler.body)
+
+
+def _expr_calls(expr: ast.AST) -> Iterator[ast.Call]:
+    """Calls inside one expression, lambda bodies excluded — a lambda
+    runs later, on whatever stack calls it (the traced-branch check
+    inspects `lax.cond` callables explicitly)."""
+    stack: List[ast.AST] = [expr]
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, ast.Lambda) and sub is not expr:
+            continue
+        if isinstance(sub, ast.Call):
+            yield sub
+        stack.extend(ast.iter_child_nodes(sub))
+
+
+def _agreed_iterable(it: ast.AST, taint: "_Taint") -> bool:
+    """True for bounded, congruent iteration: ``range`` over untainted
+    bounds, or ``enumerate``/``sorted``/``reversed`` over values that
+    PROVABLY derive from an agreement step. A parameter stream wrapped
+    in ``enumerate(windows)`` is still per-process data whose length
+    can diverge — the wrapper must not launder it."""
+    if not isinstance(it, ast.Call) or taint.is_tainted_expr(it):
+        return False
+    last = _call_last(it)
+    if last == "range":
+        return True
+    if last in ("enumerate", "sorted", "reversed"):
+        return all(
+            sub.id in taint.agreed
+            for arg in it.args
+            for sub in ast.walk(arg)
+            if isinstance(sub, ast.Name)
+        )
+    return False
+
+
+class _Taint:
+    """Per-function name classification: tainted = host-local."""
+
+    def __init__(self, fn: ast.AST) -> None:
+        self.tainted: Set[str] = set()
+        # Names PROVABLY derived from an agreement step (gathered
+        # data): the only values sanctioned to bound a collective-
+        # bearing loop through enumerate/sorted wrappers.
+        self.agreed: Set[str] = set()
+        # Parameters are implicitly agreed by NOT being tainted — the
+        # config-contract default; no explicit set needed.
+        # Two passes: simple forward propagation reaches fixpoint on
+        # real protocol code (assignment chains, loop-carried names).
+        for _ in range(2):
+            self._scan(fn.body)
+
+    def is_tainted_expr(self, expr: Optional[ast.AST]) -> bool:
+        if expr is None:
+            return False
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                return True
+            if isinstance(sub, ast.Call):
+                last = _call_last(sub)
+                if last in _TAINT_CALLS:
+                    return True
+        return False
+
+    def _taint_target(self, target: ast.AST) -> None:
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name):
+                self.tainted.add(sub.id)
+
+    def _scan(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in _iter_own_statements(body):
+            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                value = stmt.value
+                if value is None:
+                    continue
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                # Agreement results override taint — `rows =
+                # gather_headers(...)`, including wrapped forms like
+                # `np.asarray(process_allgather(...)).reshape(...)`:
+                # tainted operands went INTO the collective, but the
+                # gather IS the agreement step and its output is
+                # identical everywhere.
+                if any(
+                    isinstance(sub, ast.Call)
+                    and _call_last(sub) in AGREEMENT_SOURCES
+                    for sub in ast.walk(value)
+                ) or (
+                    # Propagation: derived purely from agreed names
+                    # (`live = peers[peers[:, 0] >= 0]`) stays agreed.
+                    self.agreed
+                    and all(
+                        sub.id in self.agreed
+                        for sub in ast.walk(value)
+                        if isinstance(sub, ast.Name)
+                    )
+                ):
+                    for t in targets:
+                        for sub in ast.walk(t):
+                            if isinstance(sub, ast.Name):
+                                self.tainted.discard(sub.id)
+                                self.agreed.add(sub.id)
+                    continue
+                if self.is_tainted_expr(value) or (
+                    isinstance(stmt, ast.AugAssign)
+                    and self.is_tainted_expr(stmt.target)
+                ):
+                    for t in targets:
+                        self._taint_target(t)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                # Iterating a data stream yields host-local items; only
+                # range/enumerate/sorted over agreed values stay agreed.
+                if not _agreed_iterable(stmt.iter, self):
+                    self._taint_target(stmt.target)
+            elif isinstance(stmt, ast.Try):
+                for handler in stmt.handlers:
+                    if handler.name:
+                        self.tainted.add(handler.name)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    if item.optional_vars is not None and self.is_tainted_expr(
+                        item.context_expr
+                    ):
+                        self._taint_target(item.optional_vars)
+
+
+def _branch_terminates(body: Sequence[ast.stmt]) -> bool:
+    """True when the branch body always exits the linear flow (its last
+    reachable statement is return/raise/break/continue)."""
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, (ast.Return, ast.Raise, ast.Break, ast.Continue)):
+        return True
+    if isinstance(last, ast.If):
+        return (
+            bool(last.orelse)
+            and _branch_terminates(last.body)
+            and _branch_terminates(last.orelse)
+        )
+    return False
+
+
+def _src(ctx: Any, node: ast.AST) -> str:
+    try:
+        text = ast.get_source_segment(ctx.text, node)
+    except Exception:  # pragma: no cover — best-effort label
+        text = None
+    if not text:
+        return "<predicate>"
+    text = " ".join(text.split())
+    return text if len(text) <= 60 else text[:57] + "..."
+
+
+class _FnWalker:
+    """Lexical governance walk over one function body."""
+
+    def __init__(self, rel: str, ctx: Any, fn: ast.AST, qual: str) -> None:
+        self.rel = rel
+        self.ctx = ctx
+        self.fn = fn
+        self.qual = qual
+        self.taint = _Taint(fn)
+        self.findings: List[Finding] = []
+        self.order: List[Tuple[int, str]] = []  # (line, op)
+        self.local_defs: Dict[str, ast.AST] = {
+            n.name: n
+            for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn
+        }
+
+    def run(self) -> None:
+        self._walk(self.fn.body, governing=[], in_handler=False)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _collectives_in_expr(self, expr: ast.AST) -> List[ast.Call]:
+        out = []
+        for call in _expr_calls(expr):
+            if _call_last(call) in LOCKSTEP_OPS:
+                out.append(call)
+        return out
+
+    def _callable_has_collective(self, expr: ast.AST) -> Optional[str]:
+        """Collective op name inside a branch callable (lambda body or
+        a same-function nested def referenced by name), or None."""
+        body: Optional[ast.AST] = None
+        if isinstance(expr, ast.Lambda):
+            body = expr.body
+        elif isinstance(expr, ast.Name) and expr.id in self.local_defs:
+            body = self.local_defs[expr.id]
+        if body is None:
+            return None
+        for sub in ast.walk(body):
+            if (
+                isinstance(sub, ast.Call)
+                and _call_last(sub) in LOCKSTEP_OPS
+            ):
+                return _call_last(sub)
+        return None
+
+    def _note_collective(
+        self,
+        call: ast.Call,
+        governing: List[Tuple[ast.AST, int, bool]],
+        in_handler: bool,
+    ) -> None:
+        op = _call_last(call)
+        assert op is not None
+        self.order.append((call.lineno, op))
+        if in_handler:
+            self.findings.append(
+                Finding(
+                    NAME,
+                    CODE,
+                    self.rel,
+                    call.lineno,
+                    f"lockstep collective `{op}` inside an except "
+                    "handler: peers that did not raise never reach it "
+                    "— one-sided divergence strands them; encode the "
+                    "failure into the next agreement step (width −2 / "
+                    "payload-confirm) and raise on every process "
+                    "together",
+                )
+            )
+            return
+        for test, line, force in governing:
+            if force or self.taint.is_tainted_expr(test):
+                self.findings.append(
+                    Finding(
+                        NAME,
+                        CODE,
+                        self.rel,
+                        call.lineno,
+                        f"lockstep collective `{op}` is governed by a "
+                        f"branch on host-local state (`{_src(self.ctx, test)}` "
+                        f"at line {line}): a process whose local data "
+                        "takes the other side skips the collective and "
+                        "strands every peer — derive the predicate "
+                        "from a prior agreement step (gathered header/"
+                        "confirm data) or issue the collective "
+                        "unconditionally",
+                    )
+                )
+                return  # one finding per collective site
+
+    def _scan_stmt_exprs(
+        self,
+        exprs: Iterable[Optional[ast.AST]],
+        governing: List[Tuple[ast.AST, int, bool]],
+        in_handler: bool,
+    ) -> None:
+        for expr in exprs:
+            if expr is None:
+                continue
+            for call in _expr_calls(expr):
+                last = _call_last(call)
+                if last in LOCKSTEP_OPS:
+                    self._note_collective(call, governing, in_handler)
+                elif last in _TRACED_BRANCH_CALLS:
+                    for arg in call.args:
+                        op = self._callable_has_collective(arg)
+                        if op is not None:
+                            self.findings.append(
+                                Finding(
+                                    NAME,
+                                    CODE,
+                                    self.rel,
+                                    call.lineno,
+                                    f"collective `{op}` inside a "
+                                    f"`lax.{last}` branch callable: the "
+                                    "traced predicate selects the branch "
+                                    "per device, so devices disagree on "
+                                    "whether the collective runs — hoist "
+                                    "it above the cond (the pod dense "
+                                    "program's unconditional all_gather "
+                                    "shape)",
+                                )
+                            )
+
+    # -- walk ---------------------------------------------------------------
+
+    def _walk(
+        self,
+        body: Sequence[ast.stmt],
+        governing: List[Tuple[ast.AST, int, bool]],
+        in_handler: bool,
+    ) -> None:
+        governing = list(governing)
+        for stmt in body:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue  # analyzed as their own functions
+            if isinstance(stmt, ast.If):
+                self._scan_stmt_exprs([stmt.test], governing, in_handler)
+                inner = governing + [(stmt.test, stmt.lineno, False)]
+                self._walk(stmt.body, inner, in_handler)
+                self._walk(stmt.orelse, inner, in_handler)
+                # One-sided exit: the test governs everything after.
+                if _branch_terminates(stmt.body) or (
+                    stmt.orelse and _branch_terminates(stmt.orelse)
+                ):
+                    governing.append((stmt.test, stmt.lineno, False))
+            elif isinstance(stmt, ast.While):
+                self._scan_stmt_exprs([stmt.test], governing, in_handler)
+                is_const_true = (
+                    isinstance(stmt.test, ast.Constant)
+                    and stmt.test.value is True
+                )
+                inner = governing + (
+                    []
+                    if is_const_true
+                    else [(stmt.test, stmt.lineno, False)]
+                )
+                self._walk(stmt.body, inner, in_handler)
+                self._walk(stmt.orelse, governing, in_handler)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_stmt_exprs([stmt.iter], governing, in_handler)
+                # A loop over per-process data governs its body's
+                # collectives: stream lengths diverge across processes,
+                # so trip counts do too (the exact deadlock the synced
+                # streams' while-True + liveness codes exist to avoid).
+                inner = governing + (
+                    []
+                    if _agreed_iterable(stmt.iter, self.taint)
+                    else [(stmt.iter, stmt.lineno, True)]
+                )
+                self._walk(stmt.body, inner, in_handler)
+                self._walk(stmt.orelse, governing, in_handler)
+            elif isinstance(stmt, ast.Try):
+                self._walk(stmt.body, governing, in_handler)
+                for handler in stmt.handlers:
+                    self._walk(handler.body, governing, in_handler=True)
+                self._walk(stmt.orelse, governing, in_handler)
+                self._walk(stmt.finalbody, governing, in_handler)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._scan_stmt_exprs(
+                    [item.context_expr for item in stmt.items],
+                    governing,
+                    in_handler,
+                )
+                self._walk(stmt.body, governing, in_handler)
+            else:
+                self._scan_stmt_exprs(
+                    [
+                        v
+                        for v in ast.iter_child_nodes(stmt)
+                        if isinstance(v, ast.expr)
+                    ],
+                    governing,
+                    in_handler,
+                )
+
+
+def _functions(tree: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    """Every function at any nesting depth, with a qualified name —
+    including defs inside compound statements (a kernel builder defining
+    its mirror program under ``if mirror:`` is still protocol code)."""
+
+    def visit(node: ast.AST, prefix: str) -> Iterator[Tuple[str, ast.AST]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                yield qual, child
+                yield from visit(child, qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, f"{prefix}{child.name}.")
+            elif isinstance(child, (ast.stmt, ast.excepthandler)):
+                yield from visit(child, prefix)
+
+    yield from visit(tree, "")
+
+
+def _analyze_file(
+    rel: str, ctx: Any
+) -> Tuple[List[Finding], Dict[str, List[str]]]:
+    findings: List[Finding] = []
+    orders: Dict[str, List[str]] = {}
+    for qual, fn in _functions(ctx.tree):
+        walker = _FnWalker(rel, ctx, fn, qual)
+        walker.run()
+        findings.extend(walker.findings)
+        if walker.order:
+            orders[f"{rel}::{qual}"] = [
+                op for _, op in sorted(walker.order)
+            ]
+    return findings, orders
+
+
+def collective_order(project: Project) -> Dict[str, List[str]]:
+    """Per protocol function: its lockstep collective sequence in
+    source order — the payload ``--collective-order`` emits and
+    docs/CONCURRENCY.md embeds (no line numbers: the doc must not
+    churn on unrelated edits)."""
+    out: Dict[str, List[str]] = {}
+    for top in project.rule_paths(NAME, DEFAULT_PATHS):
+        for rel in project.walk(top):
+            ctx = project.file(rel)
+            if ctx is None or ctx.tree is None:
+                continue
+            _, orders = _analyze_file(rel, ctx)
+            out.update(orders)
+    return out
+
+
+class CollectiveCongruenceRule:
+    name = NAME
+    code = CODE
+    summary = (
+        "lockstep collectives (device psum/all_gather/ppermute, host "
+        "allgathers, podstream header/confirm steps) must not be "
+        "governed by branches on host-local state"
+    )
+    project_wide = False
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for top in project.rule_paths(NAME, DEFAULT_PATHS):
+            for rel in project.walk(top):
+                ctx = project.file(rel)
+                if ctx is None or ctx.tree is None:
+                    continue
+                file_findings, _ = _analyze_file(rel, ctx)
+                findings.extend(file_findings)
+        return findings
+
+
+RULE = CollectiveCongruenceRule()

@@ -1,0 +1,546 @@
+"""GL011 donation-aliasing: donated device buffers must not have live aliases.
+
+Every hot accumulator in this tree rides ``jax.jit(...,
+donate_argnums=(0,))``: the caller's buffer is handed to XLA, which
+reuses it for the output — accumulation stays in place in HBM, the win
+every blockwise path depends on. The contract is brutal on the host
+side: after the dispatch the donated buffer is DEAD, and on CPU
+``np.asarray`` over a jax array is a zero-copy read-only view of that
+same buffer (the exact hazard the ``DeltaEntry`` copy in
+``serving/deltas.py`` documents and defuses by hand). A surviving
+alias reads recycled memory — silent corruption the checksum guard
+catches at best and a wrong Gramian serves at worst.
+
+This rule indexes every donating callable in scope — ``@partial(jax.jit,
+donate_argnums=...)`` decorated defs, ``name = jax.jit(f,
+donate_argnums=...)`` assignment forms, and (one transitive level)
+plain functions that forward a parameter into a donated position, so
+the public wrappers ``gramian_accumulate``/``sparse_gramian_accumulate``/
+``signed_scatter_pairs`` gate their call sites too — then checks each
+call site's donated argument:
+
+1. **stored attribute** — donating ``self.x`` / ``obj.attr`` leaves the
+   object holding a dead buffer for every other method (the classmodel
+   attr index names the other accessors in the finding);
+2. **view expression** — donating ``x[...]`` donates a view whose base
+   stays live in the caller;
+3. **view alias** — a ``v = np.asarray(x)`` / ``v = x[...]`` /
+   ``v = x.reshape/ravel/view/T`` alias taken before the call (with no
+   rebind of ``x`` between) dies with the donation if it is read,
+   returned, or stored afterwards — and an alias taken *after* the
+   call aliases the dead buffer unless the call rebound ``x``;
+4. **use after donation** — reading ``x`` after the donating call
+   without rebinding. The blessed shape is ``x = donating(x, ...)``:
+   rebinding through the call is what every accumulator loop here does,
+   and it makes the loop's next iteration read the fresh buffer.
+
+Function parameters forwarded into a donated position are not findings
+at the forwarding site (the wrapper inherits the donating contract and
+its own call sites are checked instead).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import (
+    Callable,
+    Dict,
+    Iterable,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
+
+from tools.graftlint.astutil import dotted_name, last_component
+from tools.graftlint.classmodel import scan_scope
+from tools.graftlint.engine import Finding, Project
+
+NAME = "donation-aliasing"
+CODE = "GL011"
+
+DEFAULT_PATHS = (
+    "spark_examples_tpu/ops",
+    "spark_examples_tpu/parallel",
+    "spark_examples_tpu/serving",
+)
+
+# View-producing numpy entry points: zero-copy over a jax array.
+_VIEW_CALLS = frozenset({"asarray", "frombuffer"})
+# Methods returning views of their receiver.
+_VIEW_METHODS = frozenset({"reshape", "ravel", "view", "transpose", "swapaxes"})
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Donated argument positions from a ``jax.jit``/``pjit``/``partial``
+    call carrying ``donate_argnums``, else None."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            val = kw.value
+            if isinstance(val, ast.Constant) and isinstance(val.value, int):
+                return (val.value,)
+            if isinstance(val, (ast.Tuple, ast.List)):
+                out = []
+                for elt in val.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, int
+                    ):
+                        out.append(elt.value)
+                return tuple(out)
+    return None
+
+
+def _jit_like(call: ast.Call) -> bool:
+    last = last_component(dotted_name(call.func))
+    return last in ("jit", "pjit", "partial")
+
+
+class _Donators:
+    """name -> donated positions, indexed over the whole scope."""
+
+    def __init__(self) -> None:
+        self.by_name: Dict[str, Tuple[int, ...]] = {}
+
+    def scan_tree(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and _jit_like(dec):
+                        pos = _donated_positions(dec)
+                        if pos:
+                            self.by_name[node.name] = pos
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if _jit_like(node.value):
+                    pos = _donated_positions(node.value)
+                    if pos:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                self.by_name[tgt.id] = pos
+
+    def close_wrappers(self, trees: Sequence[ast.AST]) -> None:
+        """One transitive level: a plain function forwarding a parameter
+        into a donated position donates that parameter itself."""
+        for tree in trees:
+            for node in ast.walk(tree):
+                if not isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if node.name in self.by_name:
+                    continue
+                params = [a.arg for a in node.args.args]
+                donated: Set[int] = set()
+                for call in ast.walk(node):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    positions = self.positions_for(call.func)
+                    if not positions:
+                        continue
+                    for p in positions:
+                        if p < len(call.args):
+                            arg = call.args[p]
+                            if (
+                                isinstance(arg, ast.Name)
+                                and arg.id in params
+                            ):
+                                donated.add(params.index(arg.id))
+                if donated:
+                    self.by_name[node.name] = tuple(sorted(donated))
+
+    def positions_for(self, func: ast.AST) -> Optional[Tuple[int, ...]]:
+        name = last_component(dotted_name(func))
+        if name is None:
+            return None
+        return self.by_name.get(name)
+
+
+def _is_view_of(expr: ast.AST, name: str) -> bool:
+    """True when ``expr`` is a zero-copy view of variable ``name``."""
+    if isinstance(expr, ast.Subscript):
+        base = expr.value
+        return isinstance(base, ast.Name) and base.id == name
+    if isinstance(expr, ast.Call):
+        last = last_component(dotted_name(expr.func))
+        if last in _VIEW_CALLS and expr.args:
+            # np.array(x) copies by default, so it is deliberately NOT
+            # in _VIEW_CALLS; asarray/frombuffer are zero-copy.
+            a = expr.args[0]
+            return isinstance(a, ast.Name) and a.id == name
+        if (
+            isinstance(expr.func, ast.Attribute)
+            and expr.func.attr in _VIEW_METHODS
+            and isinstance(expr.func.value, ast.Name)
+            and expr.func.value.id == name
+        ):
+            return True
+    if (
+        isinstance(expr, ast.Attribute)
+        and expr.attr == "T"
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == name
+    ):
+        return True
+    return False
+
+
+def _own_statements(fn: ast.AST) -> List[ast.stmt]:
+    """Function statements in source order, compound bodies flattened,
+    nested defs/classes opaque."""
+    out: List[ast.stmt] = []
+
+    def visit(body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(
+                stmt,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                continue
+            out.append(stmt)
+            for field in ("body", "orelse", "finalbody"):
+                inner = getattr(stmt, field, None)
+                if inner:
+                    visit(inner)
+            for handler in getattr(stmt, "handlers", ()):
+                visit(handler.body)
+
+    visit(fn.body)
+    out.sort(key=lambda s: (s.lineno, s.col_offset))
+    return out
+
+
+def _stmt_own_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """The expressions a flattened statement evaluates ITSELF: header
+    expressions for compound statements (their bodies are separate list
+    entries), the whole node for simple ones. Mirrors
+    ``dataflow.node_scan_roots`` — double-attributing a compound body's
+    calls to the header would double every finding."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Try):
+        return []
+    return [stmt]
+
+
+def _stmt_reads(
+    stmt: ast.stmt, name: str, skip: Optional[ast.AST] = None
+) -> bool:
+    for root in _stmt_own_exprs(stmt):
+        for sub in ast.walk(root):
+            if sub is skip:
+                continue
+            if (
+                isinstance(sub, ast.Name)
+                and sub.id == name
+                and isinstance(sub.ctx, ast.Load)
+            ):
+                return True
+    return False
+
+
+def _stmt_rebinds(stmt: ast.stmt, name: str) -> bool:
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name) and sub.id == name:
+                return True
+    return False
+
+
+def _enclosing_loops(fn: ast.AST) -> List[Tuple[ast.stmt, Set[int]]]:
+    """(loop stmt, line numbers of its body) for every loop in ``fn``."""
+    loops: List[Tuple[ast.stmt, Set[int]]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            lines = {
+                sub.lineno
+                for stmt in node.body
+                for sub in ast.walk(stmt)
+                if hasattr(sub, "lineno")
+            }
+            loops.append((node, lines))
+    return loops
+
+
+class _FnChecker:
+    def __init__(
+        self,
+        rel: str,
+        fn: ast.AST,
+        donators: _Donators,
+        attr_note: Callable[[ast.Attribute], str],
+    ) -> None:
+        self.rel = rel
+        self.fn = fn
+        self.donators = donators
+        self.attr_note = attr_note
+        self.stmts = _own_statements(fn)
+        self.loops = _enclosing_loops(fn)
+        self.findings: List[Finding] = []
+
+    def run(self) -> List[Finding]:
+        params = {a.arg for a in self.fn.args.args}
+        for i, stmt in enumerate(self.stmts):
+            for root in _stmt_own_exprs(stmt):
+                for call in ast.walk(root):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    positions = self.donators.positions_for(call.func)
+                    if not positions:
+                        continue
+                    callee = (
+                        last_component(dotted_name(call.func)) or "<callable>"
+                    )
+                    for p in positions:
+                        if p >= len(call.args):
+                            continue
+                        self._check_arg(
+                            i, stmt, call, callee, call.args[p], params
+                        )
+        return self.findings
+
+    def _check_arg(
+        self,
+        idx: int,
+        stmt: ast.stmt,
+        call: ast.Call,
+        callee: str,
+        arg: ast.AST,
+        params: Set[str],
+    ) -> None:
+        if isinstance(arg, ast.Attribute):
+            owner = dotted_name(arg.value) or "<expr>"
+            note = self.attr_note(arg) if owner == "self" else ""
+            self.findings.append(
+                Finding(
+                    NAME,
+                    CODE,
+                    self.rel,
+                    call.lineno,
+                    f"`{callee}(...)` donates the stored attribute "
+                    f"`{owner}.{arg.attr}`: after the dispatch the "
+                    "object still holds a reference to the DEAD buffer"
+                    f"{note} — donate a local and store the fresh "
+                    "result, or pass a copy",
+                )
+            )
+            return
+        if isinstance(arg, ast.Subscript):
+            self.findings.append(
+                Finding(
+                    NAME,
+                    CODE,
+                    self.rel,
+                    call.lineno,
+                    f"`{callee}(...)` donates a subscript view: the "
+                    "view's base array stays live in the caller and "
+                    "reads recycled memory after the dispatch — "
+                    "materialize a copy before donating",
+                )
+            )
+            return
+        if not isinstance(arg, ast.Name):
+            return  # a call expression: fresh value, nothing retained
+        name = arg.id
+        rebinds_self = _stmt_rebinds(stmt, name)
+        self._check_view_aliases(idx, stmt, call, callee, name, rebinds_self)
+        if rebinds_self:
+            return  # `x = donating(x, ...)` — the blessed shape
+        if name in params and not self._read_after(idx, stmt, name, call):
+            # Forwarding wrapper: its own call sites carry the check.
+            return
+        if self._read_after(idx, stmt, name, call):
+            self.findings.append(
+                Finding(
+                    NAME,
+                    CODE,
+                    self.rel,
+                    call.lineno,
+                    f"`{name}` is read after `{callee}(...)` donated "
+                    "it: the buffer was handed to XLA and may be "
+                    "recycled under the reader — rebind through the "
+                    f"call (`{name} = {callee}(...)`) or copy first",
+                )
+            )
+
+    def _read_after(
+        self, idx: int, stmt: ast.stmt, name: str, call: ast.Call
+    ) -> bool:
+        """Is ``name`` read after the donating call before any rebind —
+        including earlier statements of an enclosing loop body (the next
+        iteration runs them after the call)?"""
+        for later in self.stmts[idx + 1 :]:
+            if _stmt_reads(later, name):
+                return True
+            if _stmt_rebinds(later, name):
+                return False
+        for loop, lines in self.loops:
+            if call.lineno in lines:
+                for other in self.stmts:
+                    if other is stmt or other.lineno not in lines:
+                        continue
+                    if _stmt_reads(other, name, skip=call):
+                        return True
+        return False
+
+    def _check_view_aliases(
+        self,
+        idx: int,
+        stmt: ast.stmt,
+        call: ast.Call,
+        callee: str,
+        name: str,
+        rebinds_self: bool,
+    ) -> None:
+        # Aliases taken BEFORE the call (no rebind of `name` between):
+        # they die at donation; flag when read/stored afterwards.
+        alias_names: Set[str] = set()
+        for before in self.stmts[:idx]:
+            if _stmt_rebinds(before, name):
+                alias_names.clear()
+                continue
+            if isinstance(before, ast.Assign) and _is_view_of(
+                before.value, name
+            ):
+                for t in before.targets:
+                    if isinstance(t, ast.Name):
+                        alias_names.add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        self.findings.append(
+                            Finding(
+                                NAME,
+                                CODE,
+                                self.rel,
+                                before.lineno,
+                                f"a zero-copy view of `{name}` is "
+                                "stored on an attribute and `"
+                                f"{name}` is later donated by "
+                                f"`{callee}(...)` (line {call.lineno})"
+                                " — the stored view reads recycled "
+                                "memory; store an explicit copy "
+                                "(np.array(x, copy=True), the "
+                                "DeltaEntry discipline)",
+                            )
+                        )
+        for v in sorted(alias_names):
+            for later in self.stmts[idx:]:
+                if later is stmt:
+                    continue
+                if _stmt_reads(later, v):
+                    self.findings.append(
+                        Finding(
+                            NAME,
+                            CODE,
+                            self.rel,
+                            later.lineno,
+                            f"`{v}` is a zero-copy view of `{name}`, "
+                            f"which `{callee}(...)` donated at line "
+                            f"{call.lineno}: the view reads recycled "
+                            "memory — take an explicit copy before "
+                            "the donating dispatch",
+                        )
+                    )
+                    break
+                if _stmt_rebinds(later, v):
+                    break
+        # Aliases taken AFTER the call view the dead buffer unless the
+        # call rebound the name.
+        if rebinds_self:
+            return
+        for later in self.stmts[idx + 1 :]:
+            if _stmt_rebinds(later, name):
+                break
+            if isinstance(later, ast.Assign) and _is_view_of(
+                later.value, name
+            ):
+                self.findings.append(
+                    Finding(
+                        NAME,
+                        CODE,
+                        self.rel,
+                        later.lineno,
+                        f"zero-copy view of `{name}` taken after "
+                        f"`{callee}(...)` donated it (line "
+                        f"{call.lineno}): the buffer is dead — view "
+                        "the call's RESULT instead",
+                    )
+                )
+                break
+
+
+class DonationAliasingRule:
+    name = NAME
+    code = CODE
+    summary = (
+        "arguments donated to jit (donate_argnums) must have no live "
+        "host alias: no stored attributes, no np.asarray/slice views, "
+        "no reads after the dispatch"
+    )
+    project_wide = False
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        paths = project.rule_paths(NAME, DEFAULT_PATHS)
+        donators = _Donators()
+        trees = []
+        files: List[Tuple[str, ast.AST]] = []
+        for top in paths:
+            for rel in project.walk(top):
+                ctx = project.file(rel)
+                if ctx is None or ctx.tree is None:
+                    continue
+                donators.scan_tree(ctx.tree)
+                trees.append(ctx.tree)
+                files.append((rel, ctx.tree))
+        donators.close_wrappers(trees)
+        model = scan_scope(project, paths)
+
+        def attr_note(attr: ast.Attribute) -> str:
+            # Cross-method escape context from the classmodel index:
+            # name the OTHER methods touching this attribute, so the
+            # finding shows who reads the dead buffer.
+            holders = []
+            for info in model.classes.values():
+                for mname, m in info.methods.items():
+                    for sub in ast.walk(m):
+                        if (
+                            isinstance(sub, ast.Attribute)
+                            and sub.attr == attr.attr
+                            and isinstance(sub.value, ast.Name)
+                            and sub.value.id == "self"
+                            and sub is not attr
+                        ):
+                            holders.append(f"{info.name}.{mname}")
+                            break
+            if not holders:
+                return ""
+            return (
+                " (also accessed in "
+                + ", ".join(sorted(set(holders))[:4])
+                + ")"
+            )
+
+        findings: List[Finding] = []
+        for rel, tree in files:
+            for node in ast.walk(tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    findings.extend(
+                        _FnChecker(rel, node, donators, attr_note).run()
+                    )
+        return findings
+
+
+RULE = DonationAliasingRule()

@@ -1,0 +1,433 @@
+"""GL012 retrace-discipline: geometry reaching executables must be bucketed.
+
+``jax.jit`` caches one executable per (shapes, static args) key. The
+sparse engine's whole perf story (the pow2 panel bucketing that closed
+the r06 pod gap, the carrier buckets, the scan-chunk row padding)
+hinges on every geometry-bearing value that reaches a jit entry being
+ROUNDED through a registered bucket helper first: a raw per-window
+Python int (``lens.size``, a local nnz, an unrounded width) reaching a
+``static_argnames`` argument — or a shape-determining argument of the
+panel/carrier builders — mints a fresh executable per distinct value,
+and the bench measures XLA compilation instead of accumulation. No
+tier-1 test asserts wall-clock, so the regression is silent; this rule
+makes it a review-time failure.
+
+Checked call sites, over ``ops/`` + ``parallel/``:
+
+1. **jit entries with static_argnames** (``@partial(jax.jit,
+   static_argnames=(...))`` defs and ``jax.jit(f, static_argnames=...)``
+   assignment forms): every *geometry-named* static argument (``n``,
+   ``n_bits``, ``rows``, ``width``, ``iters``, ``chunk``, ... — dtype/
+   path/flag statics are exempt by name) must be **bucket-derived**;
+2. **executable-keyed factories** (``@functools.lru_cache`` defs, e.g.
+   the ``_sparse_tile_kernels`` compiled-kernel cache): their geometry
+   parameters gate one compiled program per distinct value, exactly
+   like a static arg;
+3. **registered shape-bearing helpers** whose arguments become jit
+   operand shapes: ``padded_carrier_matrix(n_rows=, k_bucket=)`` and
+   ``_densify_window(..., width)``.
+
+**Bucket-derived** (computed bottom-up over the calling function's
+assignments): integer constants; calls to a registered bucket helper
+(``dense_panel_width``, ``_carrier_bucket``, ``_pad_rows_for_scan``,
+``_pow2_rows``, ``randomized_panel_width``, ``round_up_multiple``;
+extendable via ``bucket_helpers`` in the rule config); the calling
+function's own parameters (the caller owns the contract — its call
+sites are checked in turn); arithmetic/`max`/`min`/`int()` over
+bucket-derived values; and ``.shape``/``.size``/``len()`` only when the
+subject is a function parameter or another operand of the same call
+(an operand's shape is already part of the executable key). Everything
+else — above all ``.size``/``.shape`` of stream-local window data — is
+raw geometry and a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.graftlint.astutil import dotted_name, last_component
+from tools.graftlint.engine import Finding, Project
+
+NAME = "retrace-discipline"
+CODE = "GL012"
+
+DEFAULT_PATHS = (
+    "spark_examples_tpu/ops",
+    "spark_examples_tpu/parallel",
+)
+
+DEFAULT_BUCKET_HELPERS = (
+    "dense_panel_width",
+    "_carrier_bucket",
+    "_pad_rows_for_scan",
+    "_pow2_rows",
+    "randomized_panel_width",
+    "round_up_multiple",
+)
+
+# Shape-bearing helper arguments that become jit operand geometry:
+# name -> (positional indices, keyword names) to check.
+SHAPE_HELPERS: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {
+    "padded_carrier_matrix": ((), ("n_rows", "k_bucket")),
+    "_densify_window": ((3,), ("width",)),
+}
+
+# A static/factory parameter is geometry-bearing when one of its
+# underscore-separated words is a size noun; dtype/path/flag statics
+# stay exempt. Word matching, not substring (the GL007 lesson).
+_GEOMETRY_WORDS = frozenset(
+    {
+        "n",
+        "k",
+        "v",
+        "rows",
+        "cols",
+        "width",
+        "widths",
+        "bits",
+        "len",
+        "size",
+        "count",
+        "samples",
+        "variants",
+        "padded",
+        "bucket",
+        "chunk",
+        "iters",
+        "depth",
+    }
+)
+_WORD_SPLIT = re.compile(r"[^a-zA-Z0-9]+")
+
+# Numeric wrappers that preserve bucket-derivation (range/enumerate:
+# bounded iteration over derived bounds stays derived).
+_PASSTHROUGH_CALLS = frozenset(
+    {"max", "min", "int", "abs", "range", "enumerate"}
+)
+
+
+def is_geometry_name(name: str) -> bool:
+    return any(
+        w in _GEOMETRY_WORDS for w in _WORD_SPLIT.split(name.lower()) if w
+    )
+
+
+def _static_names(call: ast.Call) -> Tuple[str, ...]:
+    """static_argnames from a jit/pjit/partial call, else ()."""
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            val = kw.value
+            if isinstance(val, ast.Constant) and isinstance(val.value, str):
+                return (val.value,)
+            if isinstance(val, (ast.Tuple, ast.List)):
+                return tuple(
+                    elt.value
+                    for elt in val.elts
+                    if isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)
+                )
+    return ()
+
+
+def _jit_like(call: ast.Call) -> bool:
+    return last_component(dotted_name(call.func)) in ("jit", "pjit", "partial")
+
+
+def _lru_like(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    return last_component(dotted_name(dec)) in ("lru_cache", "cache")
+
+
+class _Entry:
+    """One executable-keyed callable: which args carry geometry."""
+
+    __slots__ = ("name", "kind", "positions", "keywords")
+
+    def __init__(
+        self,
+        name: str,
+        kind: str,
+        positions: Tuple[int, ...],
+        keywords: Tuple[str, ...],
+    ) -> None:
+        self.name = name
+        self.kind = kind  # "static" | "factory" | "shape"
+        self.positions = positions
+        self.keywords = keywords
+
+
+def _index_entries(trees: Sequence[ast.AST]) -> Dict[str, _Entry]:
+    entries: Dict[str, _Entry] = {
+        name: _Entry(name, "shape", pos, kws)
+        for name, (pos, kws) in SHAPE_HELPERS.items()
+    }
+
+    def geometry_params(
+        fn: ast.AST, only: Optional[Sequence[str]] = None
+    ) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+        params = [a.arg for a in fn.args.args]
+        names = [
+            p
+            for p in (only if only is not None else params)
+            if p in params and is_geometry_name(p)
+        ]
+        return tuple(params.index(p) for p in names), tuple(names)
+
+    for tree in trees:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and _jit_like(dec):
+                        statics = _static_names(dec)
+                        if statics:
+                            pos, kws = geometry_params(node, statics)
+                            if kws:
+                                entries[node.name] = _Entry(
+                                    node.name, "static", pos, kws
+                                )
+                    elif _lru_like(dec):
+                        pos, kws = geometry_params(node)
+                        if kws:
+                            entries[node.name] = _Entry(
+                                node.name, "factory", pos, kws
+                            )
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if _jit_like(node.value):
+                    statics = _static_names(node.value)
+                    inner = node.value.args[0] if node.value.args else None
+                    if statics and isinstance(inner, ast.Name):
+                        # name = jax.jit(f, static_argnames=...): the
+                        # static names index into f's signature, which
+                        # this pass does not resolve — geometry-named
+                        # statics are checked by NAME at call sites via
+                        # keywords only.
+                        geo = tuple(
+                            s for s in statics if is_geometry_name(s)
+                        )
+                        if geo:
+                            for tgt in node.targets:
+                                if isinstance(tgt, ast.Name):
+                                    entries[tgt.id] = _Entry(
+                                        tgt.id, "static", (), geo
+                                    )
+    return entries
+
+
+class _Derivation:
+    """Bucket-derivation over one calling function."""
+
+    def __init__(self, fn: ast.AST, helpers: Set[str]) -> None:
+        self.helpers = helpers
+        self.params = {
+            a.arg
+            for a in list(fn.args.args)
+            + list(fn.args.posonlyargs)
+            + list(fn.args.kwonlyargs)
+        }
+        self.derived: Set[str] = set(self.params)
+        # Lambda parameters are parameters too (the
+        # `lambda kk: principal_components(c, kk)` finish idiom).
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Lambda):
+                for a in node.args.args:
+                    self.params.add(a.arg)
+                    self.derived.add(a.arg)
+        # Two forward passes over the function's assignments reach a
+        # fixpoint on real accumulator code.
+        assigns: List[Tuple[ast.AST, ast.AST]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    assigns.append((t, node.value))
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                assigns.append((node.target, node.value))
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                # A loop target over range/enumerate of bucket-derived
+                # bounds is bounded, parameter-congruent iteration (the
+                # fused retry-doubling shape); data-stream targets stay
+                # raw.
+                it = node.iter
+                if (
+                    isinstance(it, ast.Call)
+                    and last_component(dotted_name(it.func))
+                    in ("range", "enumerate")
+                ):
+                    assigns.append((node.target, it))
+        assigns.sort(key=lambda tv: getattr(tv[1], "lineno", 0))
+        for _ in range(2):
+            for target, value in assigns:
+                if isinstance(target, ast.Name):
+                    if self.blessed(value, other_args=frozenset()):
+                        self.derived.add(target.id)
+                elif isinstance(target, ast.Tuple):
+                    # Conservative: a tuple unpack blesses its targets
+                    # only when the whole RHS is blessed.
+                    if self.blessed(value, other_args=frozenset()):
+                        for elt in target.elts:
+                            if isinstance(elt, ast.Name):
+                                self.derived.add(elt.id)
+
+    def blessed(self, expr: ast.AST, other_args: frozenset) -> bool:
+        if isinstance(expr, ast.Constant):
+            return True
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return all(self.blessed(e, other_args) for e in expr.elts)
+        if isinstance(expr, ast.Name):
+            # UPPERCASE names are module constants by convention —
+            # compile-time geometry (_DEF_ITERS, SCATTER_CHUNK_VARIANTS).
+            return expr.id in self.derived or expr.id.isupper()
+        if isinstance(expr, ast.BinOp):
+            return self.blessed(expr.left, other_args) and self.blessed(
+                expr.right, other_args
+            )
+        if isinstance(expr, ast.UnaryOp):
+            return self.blessed(expr.operand, other_args)
+        if isinstance(expr, ast.IfExp):
+            return self.blessed(expr.body, other_args) and self.blessed(
+                expr.orelse, other_args
+            )
+        if isinstance(expr, ast.Compare):
+            return all(
+                self.blessed(e, other_args)
+                for e in [expr.left, *expr.comparators]
+            )
+        if isinstance(expr, ast.BoolOp):
+            return all(self.blessed(v, other_args) for v in expr.values)
+        if isinstance(expr, ast.Call):
+            last = last_component(dotted_name(expr.func))
+            if last in self.helpers:
+                return True  # the bucket helper IS the blessing
+            if last == "len":
+                # len() of an array is raw geometry unless the subject's
+                # shape already rides the executable key.
+                return bool(expr.args) and self._shape_subject_ok(
+                    expr.args[0], other_args
+                )
+            if last in _PASSTHROUGH_CALLS:
+                return all(
+                    self.blessed(a, other_args) for a in expr.args
+                )
+            return False
+        if isinstance(expr, ast.Attribute):
+            # x.size / x.shape: raw geometry unless the subject's shape
+            # is already part of the executable key.
+            if expr.attr in ("size", "shape"):
+                return self._shape_subject_ok(expr.value, other_args)
+            return self.blessed(expr.value, other_args)
+        if isinstance(expr, ast.Subscript):
+            return self.blessed(expr.value, other_args)
+        return False
+
+    def _shape_subject_ok(
+        self, subject: ast.AST, other_args: frozenset
+    ) -> bool:
+        return (
+            isinstance(subject, ast.Name)
+            and (
+                subject.id in self.params
+                or subject.id in other_args
+            )
+        )
+
+
+def _call_arg_names(call: ast.Call) -> frozenset:
+    names = set()
+    for a in call.args:
+        if isinstance(a, ast.Name):
+            names.add(a.id)
+    for kw in call.keywords:
+        if isinstance(kw.value, ast.Name):
+            names.add(kw.value.id)
+    return frozenset(names)
+
+
+class RetraceDisciplineRule:
+    name = NAME
+    code = CODE
+    summary = (
+        "geometry reaching static args / executable-keyed factories / "
+        "panel+carrier builders must come from the registered bucket "
+        "helpers or compile-time constants, never raw per-window ints"
+    )
+    project_wide = False
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        paths = project.rule_paths(NAME, DEFAULT_PATHS)
+        cfg = project.config.get("rules", {}).get(NAME, {})
+        helpers = set(DEFAULT_BUCKET_HELPERS) | set(
+            cfg.get("bucket_helpers", ())
+        )
+        files: List[Tuple[str, ast.AST]] = []
+        for top in paths:
+            for rel in project.walk(top):
+                ctx = project.file(rel)
+                if ctx is None or ctx.tree is None:
+                    continue
+                files.append((rel, ctx.tree))
+        entries = _index_entries([tree for _, tree in files])
+        findings: List[Finding] = []
+        for rel, tree in files:
+            for node in ast.walk(tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    findings.extend(
+                        self._check_fn(rel, node, entries, helpers)
+                    )
+        return findings
+
+    def _check_fn(
+        self,
+        rel: str,
+        fn: ast.AST,
+        entries: Dict[str, _Entry],
+        helpers: Set[str],
+    ) -> List[Finding]:
+        derivation = _Derivation(fn, helpers)
+        findings: List[Finding] = []
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            entry = entries.get(last_component(dotted_name(call.func)) or "")
+            if entry is None:
+                continue
+            other_args = _call_arg_names(call)
+            checked: List[Tuple[str, ast.AST]] = []
+            for pos in entry.positions:
+                if pos < len(call.args):
+                    checked.append((f"arg {pos}", call.args[pos]))
+            for kw in call.keywords:
+                if kw.arg in entry.keywords:
+                    checked.append((kw.arg, kw.value))
+            for label, expr in checked:
+                if derivation.blessed(expr, other_args):
+                    continue
+                kind_txt = {
+                    "static": "static (executable-key) argument",
+                    "factory": "executable-cache factory argument",
+                    "shape": "shape-determining argument",
+                }[entry.kind]
+                findings.append(
+                    Finding(
+                        NAME,
+                        CODE,
+                        rel,
+                        call.lineno,
+                        f"`{entry.name}(...)` {kind_txt} `{label}` is "
+                        "raw per-call geometry: every distinct value "
+                        "mints a fresh executable (silent retraces ate "
+                        "the r06 pod win) — round it through a "
+                        "registered bucket helper "
+                        f"({', '.join(sorted(helpers))}) or derive it "
+                        "from function parameters/constants",
+                    )
+                )
+        return findings
+
+
+RULE = RetraceDisciplineRule()
