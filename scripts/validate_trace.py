@@ -81,6 +81,17 @@ _SPARSE_SPANS = {
                                   # gang pull + exchanges + payload)
 }
 
+# Read-level kernel pipeline span contract (models/pairhmm.py): every
+# `pairhmm.<sub>` span must be one of these — the reads-workload
+# capture windows attribute host-prep vs device-forward time from
+# exactly this set.
+_PAIRHMM_SPANS = {
+    "pairhmm.bucket",   # one shard's host prep: read streaming,
+                        # consensus vote, pair building + bucketing
+    "pairhmm.forward",  # one batched forward dispatch (bucket + pair
+                        # count in args)
+}
+
 # Prometheus exposition line shapes (text format 0.0.4).
 _PROM_COMMENT = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ?.*$")
 _PROM_SAMPLE = re.compile(
@@ -192,6 +203,14 @@ def validate_trace(path: str) -> List[str]:
                 f"{ev['name']!r} (expected one of "
                 f"{sorted(_SPARSE_SPANS)})"
             )
+        elif (
+            ev["name"].startswith("pairhmm.")
+            and ev["name"] not in _PAIRHMM_SPANS
+        ):
+            errors.append(
+                f"{where}: unknown pairhmm span {ev['name']!r} "
+                f"(expected one of {sorted(_PAIRHMM_SPANS)})"
+            )
         if not isinstance(ev.get("pid"), int):
             errors.append(f"{where}: pid must be an int")
         if ph != "M":
@@ -231,6 +250,8 @@ _LABELED_COUNTERS = {
     "cold_stream_shards_total": "stage",  # fetched/accumulated per shard
     "collective_check_steps_total": "outcome",  # agree/divergence per
                                           # cross-checked pod step
+    "pairhmm_pairs_total": "bucket",      # scored pairs per (read, hap)
+                                          # length bucket (rRxhH)
     "serving_delta_jobs_total": "outcome",  # hit/fallback/miss
     "serving_jobs_total": "outcome",      # done/failed/cached/deduped
     "serving_shed_total": "reason",       # queue_full/quota

@@ -350,6 +350,24 @@ def _cmd_reads_example(args) -> int:
     return 0
 
 
+def _cmd_pairhmm(args) -> int:
+    """The reads-side kernel pipeline: batched PairHMM scoring."""
+    _enable_compile_cache()
+    from spark_examples_tpu.models.pairhmm import PairHmmDriver
+
+    conf = pca_config_from_args(args)
+    # Default region = synthetic_reads' default window, so a bare
+    # `pairhmm --fixture-reads N` scores out of the box (the same
+    # default-region discipline as the reads examples).
+    conf.references = args.references or "11:6888648:6890648"
+    source, rgsid = _resolve_reads_source(args, conf.references)
+    if not conf.read_group_set_id:
+        conf.read_group_set_id = rgsid
+    driver = PairHmmDriver(conf, source)
+    driver.run(out_path=args.output_path)
+    return 0
+
+
 def _cmd_pca_bridge(args) -> int:
     """Serve the PcaBackend seam over TCP."""
     _enable_compile_cache()
@@ -415,11 +433,23 @@ def _analysis_tier(args, source):
             "in-memory only and a crash forgets them all.",
             file=sys.stderr,
         )
+    import os
+
+    # The delta cache persists beside the journal: a kill -9'd server
+    # restarted on the same --analyze-journal-dir answers ±k cohort
+    # deltas warm (checksummed write-through; torn entries drop loudly
+    # to cold on re-load).
+    delta_persist = (
+        os.path.join(args.analyze_journal_dir, "deltas")
+        if args.analyze_journal_dir and args.delta_max_samples > 0
+        else None
+    )
     tier = AnalysisJobTier(
         AnalysisEngine(
             source,
             mesh=mesh,
             delta_max_samples=args.delta_max_samples,
+            delta_persist_dir=delta_persist,
         ),
         base,
         queue_depth=args.analyze_queue_depth,
@@ -609,14 +639,24 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="Run against synthetic reads",
     )
-    reads.add_argument(
-        "--read-group-set-id",
-        default=None,
-        help="Readset id filter (default: all readsets in the cohort)",
-    )
     reads.add_argument("--normal-id", default=None)
     reads.add_argument("--tumor-id", default=None)
     reads.set_defaults(references=None, fn=_cmd_reads_example)
+
+    phmm = sub.add_parser(
+        "pairhmm",
+        help="Score every read against its consensus haplotype with "
+        "the batched TPU PairHMM forward kernel",
+    )
+    add_pca_flags(phmm)
+    _add_fixture_flags(phmm)
+    phmm.add_argument(
+        "--fixture-reads",
+        type=int,
+        default=None,
+        help="Run against synthetic reads",
+    )
+    phmm.set_defaults(references=None, fn=_cmd_pairhmm)
 
     bridge = sub.add_parser(
         "pca-bridge", help="Serve the PcaBackend seam over TCP"

@@ -23,6 +23,7 @@ __all__ = [
     "cohort_callsets",
     "dump_cohort_stream",
     "synthetic_reads",
+    "synthetic_read_pairs",
     "synthetic_tumor_normal",
     "DEFAULT_VARIANT_SET_ID",
     "FIXTURE_READSET_ID",
@@ -325,6 +326,74 @@ def synthetic_reads(
             }
         )
     return FixtureSource(reads=records, stats=stats)
+
+
+def synthetic_read_pairs(
+    n_pairs: int,
+    read_len: int = 6,
+    hap_len: int = 10,
+    quality: int = 20,
+    seed: int = 0,
+):
+    """Read×haplotype pairs with HAND-COMPUTABLE PairHMM likelihoods.
+
+    The PairHMM golden tests need pairs whose likelihood a reviewer can
+    derive on paper — without re-deriving ``synthetic_reads``' latent
+    haplotype (an internal its tests must stay decoupled from). Every
+    pair here has UNIFORM base quality and one of four known edit
+    structures against its haplotype:
+
+    - ``match``: the read is an exact substring — each matching
+      alignment offset contributes
+      ``(1/h) · (1-ε_ge) · (1-2ε_go)^{r-1} · (1-ε)^r`` through its
+      all-match path (free-start deletion row → gap-close into M, then
+      r matches), so that closed-form sum over offsets is a tight
+      lower bound on the likelihood — hand-checkable to ~1%;
+    - ``mismatch``: one substituted base mid-read (the dominant path
+      trades one ``1-ε`` for ``ε/3``);
+    - ``insert``: one extra base mid-read (the dominant path opens and
+      closes one insertion);
+    - ``delete``: one haplotype base skipped mid-read (one deletion).
+
+    Returns a list of dicts: ``name``, ``kind``, ``offset`` (the true
+    alignment offset), ``read``/``quals``/``hap`` numpy arrays in the
+    kernel's code space. Deterministic per seed.
+    """
+    if hap_len < read_len + 2:
+        raise ValueError(
+            f"hap_len {hap_len} must exceed read_len {read_len} by >= 2 "
+            "(the insert/delete structures need slack)"
+        )
+    rng = np.random.default_rng(seed)
+    kinds = ("match", "mismatch", "insert", "delete")
+    pairs = []
+    for i in range(n_pairs):
+        kind = kinds[i % len(kinds)]
+        hap = rng.integers(0, 4, size=hap_len).astype(np.int8)
+        off = int(rng.integers(0, hap_len - read_len - 1))
+        read = hap[off : off + read_len].copy()
+        mid = read_len // 2
+        if kind == "mismatch":
+            read[mid] = (read[mid] + 1 + int(rng.integers(0, 3))) % 4
+        elif kind == "insert":
+            read = np.insert(read, mid, (hap[off + mid] + 2) % 4)[
+                :read_len
+            ].astype(np.int8)
+        elif kind == "delete":
+            read = np.delete(
+                np.append(read, hap[off + read_len]), mid
+            ).astype(np.int8)
+        pairs.append(
+            {
+                "name": f"pair-{i}-{kind}",
+                "kind": kind,
+                "offset": off,
+                "read": read,
+                "quals": np.full(read.size, quality, dtype=np.int32),
+                "hap": hap,
+            }
+        )
+    return pairs
 
 
 NORMAL_READSET_ID = "fixture-normal"

@@ -33,6 +33,8 @@ from __future__ import annotations
 
 import collections
 import json
+import os
+import sys
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -145,13 +147,28 @@ class DeltaEntry:
 
 class DeltaIndex:
     """Thread-safe nearest-ancestor index of cached Gramians + the
-    per-base-key full-frame window cache (both byte-bounded LRU)."""
+    per-base-key full-frame window cache (both byte-bounded LRU).
+
+    ``persist_dir`` arms WRITE-THROUGH persistence: every inserted
+    Gramian entry also lands as an ``.npz`` beside the job journal
+    (atomic tmp→fsync→rename, the mirror-staging discipline), and a
+    restarted index re-loads the directory — so a ``kill -9``'d server
+    answers ±k delta queries warm instead of re-running every ancestor
+    cold. The insert-time checksum rides the file and is RE-VERIFIED at
+    load: a torn, truncated, or stale entry is dropped LOUDLY (warning
+    + file unlink) and that cohort simply runs cold — persistence is an
+    optimization and can never change results (the same posture as the
+    in-memory checksum guard). The window cache is NOT persisted: the
+    first delta against a re-loaded ancestor re-streams host ingest
+    once and re-captures.
+    """
 
     def __init__(
         self,
         max_delta_samples: int = DEFAULT_DELTA_MAX_SAMPLES,
         max_bytes: int = _GRAMIAN_CACHE_BYTES,
         max_window_bytes: int = _WINDOW_CACHE_BYTES,
+        persist_dir: Optional[str] = None,
     ) -> None:
         self.max_delta_samples = max(0, max_delta_samples)
         self.max_bytes = max(1, max_bytes)
@@ -167,6 +184,157 @@ class DeltaIndex:
             collections.OrderedDict()
         )
         self._window_bytes: Dict[str, int] = {}
+        self._persist_dir = persist_dir
+        if persist_dir is not None:
+            os.makedirs(persist_dir, exist_ok=True)
+            self._load_persisted()
+
+    # -- persistence ----------------------------------------------------------
+
+    @staticmethod
+    def _entry_filename(base_key: str, samples: Tuple[str, ...]) -> str:
+        """Deterministic per-(base key, frame) filename — recomputable,
+        so eviction/drop can unlink without tracking state."""
+        from spark_examples_tpu.genomics.hashing import murmur3_x64_128
+
+        frame = murmur3_x64_128(
+            "\x00".join(samples).encode("utf-8")
+        ).hex()[:16]
+        return f"delta-{base_key[:16]}-{frame}.npz"
+
+    def _entry_path(self, entry: DeltaEntry) -> Optional[str]:
+        if self._persist_dir is None:
+            return None
+        return os.path.join(
+            self._persist_dir,
+            self._entry_filename(entry.base_key, entry.samples),
+        )
+
+    def _persist_entry(self, entry: DeltaEntry) -> None:
+        """Write one entry through to disk (atomic: a kill mid-write
+        leaves only a ``.tmp-`` partial the next load sweeps)."""
+        path = self._entry_path(entry)
+        if path is None:
+            return
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(
+                    f,
+                    g=entry.g,
+                    samples=np.asarray(entry.samples, dtype=np.str_),
+                    base_key=np.asarray(entry.base_key),
+                    checksum=np.asarray(entry.checksum),
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as e:
+            # Disk weather costs only restart warmth, never a result.
+            print(
+                f"WARNING: delta-cache persist failed for {path} "
+                f"({type(e).__name__}: {e}); entry stays memory-only.",
+                file=sys.stderr,
+            )
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _unlink_entry(self, entry: DeltaEntry) -> None:
+        path = self._entry_path(entry)
+        if path is None:
+            return
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    def _load_persisted(self) -> None:
+        """Re-load persisted entries, loudest-possible skepticism: any
+        unreadable/torn/checksum-mismatched file is warned about and
+        unlinked — the affected cohort runs cold, exactly as if the
+        entry had never been written."""
+        assert self._persist_dir is not None
+        loaded = 0
+        for name in sorted(os.listdir(self._persist_dir)):
+            path = os.path.join(self._persist_dir, name)
+            if ".tmp-" in name:
+                # A kill mid-persist's partial: never parse, just sweep.
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            if not name.endswith(".npz"):
+                continue
+            try:
+                with np.load(path, allow_pickle=False) as doc:
+                    g = np.asarray(doc["g"], dtype=np.float32)
+                    samples = tuple(str(s) for s in doc["samples"])
+                    base_key = str(doc["base_key"])
+                    checksum = str(doc["checksum"])
+                if gramian_checksum(g) != checksum:
+                    raise ValueError(
+                        "stored checksum does not match the G bytes"
+                    )
+            except Exception as e:  # noqa: BLE001 — torn/stale cache file
+                print(
+                    f"WARNING: dropping torn/stale delta-cache entry "
+                    f"{path} ({type(e).__name__}: {e}); that cohort "
+                    "runs cold and re-warms.",
+                    file=sys.stderr,
+                )
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            # In-memory insert WITHOUT re-persisting (the file is the
+            # source we just read); oversized entries obey the same
+            # budget rule as live puts.
+            entry = DeltaEntry(base_key, samples, g)
+            if entry.g.nbytes > self.max_bytes // _MAX_ENTRY_FRACTION:
+                # Over the per-entry budget share (a shrunken budget
+                # since it was written): drop the file too, or every
+                # restart re-reads and re-verifies the same dead entry.
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            with self._lock:
+                self._entries[(base_key, samples)] = entry
+                self._bytes += entry.g.nbytes
+                evicted = self._evict_over_budget_locked()
+            for gone in evicted:
+                # A persisted set over the byte budget sheds its
+                # oldest files here, or every restart would re-read,
+                # re-verify, and re-evict the same dead entries.
+                if gone is not entry:
+                    self._unlink_entry(gone)
+            loaded += 1
+        if loaded:
+            print(
+                f"Delta cache re-loaded: {loaded} persisted Gramian "
+                f"entr{'y' if loaded == 1 else 'ies'} "
+                f"(warm ±k answers survive the restart)."
+            )
+
+    def _evict_over_budget_locked(self) -> List[DeltaEntry]:
+        """Pop LRU entries past the byte budget; the caller unlinks the
+        returned entries' files outside the lock."""
+        from spark_examples_tpu.utils.lockcheck import assert_lock_held
+
+        assert_lock_held(
+            self._lock, "DeltaIndex._evict_over_budget_locked"
+        )
+        evicted: List[DeltaEntry] = []
+        while self._bytes > self.max_bytes and len(self._entries) > 1:
+            _, entry = self._entries.popitem(last=False)
+            self._bytes -= entry.g.nbytes
+            evicted.append(entry)
+        return evicted
 
     # -- Gramian entries ------------------------------------------------------
 
@@ -199,7 +367,10 @@ class DeltaIndex:
         self, base_key: str, samples: Sequence[str], g: np.ndarray
     ) -> None:
         """Insert/refresh one finished Gramian (no-op when a single G
-        would consume more than its budget share)."""
+        would consume more than its budget share). With persistence
+        armed the entry writes through to disk — file I/O OUTSIDE the
+        index lock (the journal-append discipline: concurrent resolves
+        must never stall on a slow disk)."""
         entry = DeltaEntry(base_key, tuple(samples), g)
         if entry.g.nbytes > self.max_bytes // _MAX_ENTRY_FRACTION:
             return
@@ -210,17 +381,31 @@ class DeltaIndex:
                 self._bytes -= old.g.nbytes
             self._entries[key] = entry
             self._bytes += entry.g.nbytes
-            while self._bytes > self.max_bytes and len(self._entries) > 1:
-                _, evicted = self._entries.popitem(last=False)
-                self._bytes -= evicted.g.nbytes
+            evicted = self._evict_over_budget_locked()
+        self._persist_entry(entry)
+        for gone in evicted:
+            if gone is not entry:
+                self._unlink_entry(gone)
+        # Re-check membership AFTER persisting: a concurrent put() may
+        # have evicted this entry (and unlinked its file) between the
+        # insert and the write above — the re-written file would then
+        # orphan an entry no longer in memory. Every interleaving
+        # converges: whichever of the evictor's unlink and this one
+        # runs last removes the file.
+        with self._lock:
+            still_in = self._entries.get(key) is entry
+        if not still_in:
+            self._unlink_entry(entry)
 
     def drop(self, entry: DeltaEntry) -> None:
-        """Remove a corrupt entry (checksum guard tripped)."""
+        """Remove a corrupt entry (checksum guard tripped) — from the
+        persisted tier too, so a restart can never resurrect it."""
         key = (entry.base_key, entry.samples)
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old.g.nbytes
+        self._unlink_entry(entry)
 
     def __len__(self) -> int:
         with self._lock:

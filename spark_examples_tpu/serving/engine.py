@@ -67,6 +67,7 @@ class AnalysisEngine:
         source: Any,
         mesh: Any = None,
         delta_max_samples: int = 0,
+        delta_persist_dir: Optional[str] = None,
     ) -> None:
         self.source = source
         self.mesh = mesh
@@ -76,8 +77,13 @@ class AnalysisEngine:
         self._indexes: "collections.OrderedDict[Tuple[str, ...], object]" = (
             collections.OrderedDict()
         )
+        # delta_persist_dir (normally <journal dir>/deltas) arms the
+        # write-through tier: finished Gramians survive a kill -9 and
+        # re-load checksum-verified on restart (serving/deltas.py).
         self._deltas: Optional[DeltaIndex] = (
-            DeltaIndex(delta_max_samples)
+            DeltaIndex(
+                delta_max_samples, persist_dir=delta_persist_dir
+            )
             if delta_max_samples > 0 and mesh is None
             else None
         )
@@ -178,14 +184,24 @@ class AnalysisEngine:
 
     # -- execution ------------------------------------------------------------
 
-    def run(self, conf: Any) -> List[Tuple[str, float, float, str]]:
+    def run(
+        self, conf: Any, kind: str = "pca"
+    ) -> List[Tuple[Any, ...]]:
         """Execute one job: fresh driver, shared index, serialized
-        device phases → ``(name, pc1, pc2, dataset)`` rows. With the
-        delta tier armed, the Gramian resolves through the nearest
-        cached ancestor when one is close enough (bit-identical either
-        way)."""
+        device phases → ``(name, pc1, pc2, dataset)`` rows for the
+        default PCA kind, ``(name, loglik, bucket)`` rows for a
+        ``pairhmm`` job (the read-side kernel pipeline against the same
+        resident source). With the delta tier armed, a PCA Gramian
+        resolves through the nearest cached ancestor when one is close
+        enough (bit-identical either way)."""
         import jax.numpy as jnp
 
+        if kind == "pairhmm":
+            from spark_examples_tpu.models.pairhmm import PairHmmDriver
+
+            phmm = PairHmmDriver(conf, self.source)
+            with self._device_lock:
+                return [tuple(row) for row in phmm.run_rows()]
         driver = self._driver(conf)
         with self._device_lock:
             if self._deltas is None or self.mesh is not None:

@@ -36,6 +36,7 @@ __all__ = [
     "Job",
     "JobJournal",
     "JobSpec",
+    "JOB_KINDS",
     "cohort_key",
     "JOB_QUEUED",
     "JOB_RUNNING",
@@ -55,6 +56,7 @@ _JOURNAL_NAME = "jobs.journal.jsonl"
 # silently ran unfiltered would be a correctness bug shipped as data.
 _SPEC_FIELDS = frozenset(
     {
+        "kind",
         "tenant",
         "variant_set_id",
         "variant_set_ids",
@@ -65,7 +67,28 @@ _SPEC_FIELDS = frozenset(
         "priority",
         "samples",
         "exclude_samples",
+        "read_group_set_id",
     }
+)
+
+# Analysis job kinds the tier executes. "pca" (the default, and the
+# implied kind of every pre-kind journal record) runs the variant-side
+# PCoA; "pairhmm" runs the read-side batched PairHMM scoring pipeline
+# (models/pairhmm.py) against the served cohort's reads.
+JOB_KINDS = ("pca", "pairhmm")
+
+# Spec fields that only parameterize the variant-side analysis: a
+# pairhmm submission carrying one is a loud 400, not a silent ignore
+# (the same posture as unknown fields — a client that sets num_pc on a
+# read-scoring job misunderstands what it asked for).
+_PCA_ONLY_FIELDS = (
+    "variant_set_id",
+    "variant_set_ids",
+    "all_references",
+    "min_allele_frequency",
+    "num_pc",
+    "samples",
+    "exclude_samples",
 )
 
 
@@ -107,6 +130,11 @@ class JobSpec:
     # the spec surface the delta tier's ±k cohort queries ride.
     samples: Optional[Tuple[str, ...]] = None
     exclude_samples: Optional[Tuple[str, ...]] = None
+    # Job kind: "pca" (default) or "pairhmm" (read-side scoring).
+    kind: str = "pca"
+    # Readset filter for pairhmm jobs (None = the server's configured
+    # default readset, or every readset when that too is unset).
+    read_group_set_id: Optional[str] = None
 
     @classmethod
     def from_record(cls, rec: Dict[str, Any]) -> "JobSpec":
@@ -119,6 +147,30 @@ class JobSpec:
                 f"unknown spec field(s): {sorted(unknown)} "
                 f"(expected a subset of {sorted(_SPEC_FIELDS)})"
             )
+        kind = str(rec.get("kind", "pca") or "pca")
+        if kind not in JOB_KINDS:
+            raise ValueError(
+                f"unknown job kind {kind!r} (expected one of "
+                f"{list(JOB_KINDS)})"
+            )
+        if kind == "pairhmm":
+            misapplied = [f for f in _PCA_ONLY_FIELDS if f in rec]
+            if misapplied:
+                raise ValueError(
+                    f"spec field(s) {misapplied} do not apply to a "
+                    "pairhmm job (reads are selected by references + "
+                    "read_group_set_id)"
+                )
+        rgsid = rec.get("read_group_set_id")
+        if rgsid is not None:
+            if kind != "pairhmm":
+                raise ValueError(
+                    "read_group_set_id applies only to pairhmm jobs"
+                )
+            if not isinstance(rgsid, str) or not rgsid:
+                raise ValueError(
+                    "read_group_set_id must be a non-empty string"
+                )
         vsids = rec.get("variant_set_ids")
         if vsids is None:
             one = rec.get("variant_set_id")
@@ -162,9 +214,24 @@ class JobSpec:
             priority=priority,
             samples=_sample_list(rec, "samples"),
             exclude_samples=_sample_list(rec, "exclude_samples"),
+            kind=kind,
+            read_group_set_id=rgsid,
         )
 
     def to_record(self) -> Dict[str, Any]:
+        if self.kind == "pairhmm":
+            # Only the read-side fields: a record carrying the (inert)
+            # variant-side keys would be rejected by from_record's own
+            # misapplied-field validation on journal replay.
+            slim: Dict[str, Any] = {
+                "kind": self.kind,
+                "tenant": self.tenant,
+                "references": self.references,
+                "priority": self.priority,
+            }
+            if self.read_group_set_id is not None:
+                slim["read_group_set_id"] = self.read_group_set_id
+            return slim
         rec: Dict[str, Any] = {
             "tenant": self.tenant,
             "variant_set_ids": list(self.variant_set_ids),
@@ -181,13 +248,45 @@ class JobSpec:
             rec["samples"] = list(self.samples)
         if self.exclude_samples is not None:
             rec["exclude_samples"] = list(self.exclude_samples)
+        # No "kind" key on the default kind: pre-kind journals and
+        # their replayed record shapes stay byte-for-byte what round 12
+        # wrote (and their cohort keys stay identical).
         return rec
 
 
 def resolve_spec(spec: JobSpec, base: Any) -> Dict[str, Any]:
     """The spec with server defaults applied — the EXACT parameter set a
     job will run with, which is therefore what the cohort key must
-    cover (``base`` is the server's PcaConfig template)."""
+    cover (``base`` is the server's PcaConfig template).
+
+    A pairhmm job resolves to the read-side parameter set: the region,
+    the readset filter, and every server knob that changes a score
+    (consensus context, gap penalties, and the shard size — consensus
+    haplotypes are voted per shard window, so partitioning is part of
+    the result's identity). PCA jobs keep their historical record shape
+    exactly (no ``kind`` key), so pre-kind journals and caches resolve
+    to the same keys they always did.
+    """
+    if spec.kind == "pairhmm":
+        return {
+            "kind": "pairhmm",
+            "references": (
+                spec.references
+                if spec.references is not None
+                else base.references
+            ),
+            "read_group_set_id": (
+                spec.read_group_set_id
+                if spec.read_group_set_id is not None
+                else getattr(base, "read_group_set_id", None)
+            ),
+            "bases_per_partition": int(base.bases_per_partition),
+            "pairhmm_context": int(base.pairhmm_context),
+            "pairhmm_gap_open_phred": float(
+                base.pairhmm_gap_open_phred
+            ),
+            "pairhmm_gap_ext_phred": float(base.pairhmm_gap_ext_phred),
+        }
     return {
         "variant_set_ids": list(
             spec.variant_set_ids or base.variant_set_ids
@@ -252,6 +351,19 @@ def job_config(
     import dataclasses
 
     resolved = resolve_spec(spec, base)
+    if spec.kind == "pairhmm":
+        return dataclasses.replace(
+            base,
+            references=resolved["references"],
+            read_group_set_id=resolved["read_group_set_id"],
+            checkpoint_dir=None,
+            elastic_checkpoint=False,
+            output_path=None,
+            trace_dir=None,
+            trace_out=None,
+            metrics_out=None,
+            manifest_out=None,
+        )
     return dataclasses.replace(
         base,
         variant_set_ids=resolved["variant_set_ids"],
@@ -283,7 +395,9 @@ class Job:
     state: str = JOB_QUEUED
     cached: bool = False
     error: Optional[str] = None
-    result: Optional[List[Tuple[str, float, float, str]]] = None
+    # Row shape is per-kind: (name, pc1, pc2, dataset) for pca,
+    # (name, loglik, bucket) for pairhmm.
+    result: Optional[List[Tuple[Any, ...]]] = None
     submitted_unix: float = field(default_factory=time.time)
 
     def to_record(self, include_result: bool = True) -> Dict[str, Any]:

@@ -393,7 +393,9 @@ class AnalysisJobTier:
         shared window stream), every cohort at most ``gang_max_samples``
         samples. A lead the delta index can answer runs solo — the
         rank-k touch-up beats riding a cold gang."""
-        if self._gang_max <= 0:
+        if self._gang_max <= 0 or lead.spec.kind != "pca":
+            # Gangs stack Gramian cohorts on a batch axis; a pairhmm
+            # lead (or member) has no Gramian to stack and runs solo.
             return []
         engine = self._engine
         if (
@@ -419,8 +421,8 @@ class AnalysisJobTier:
             return []
 
         def compatible(other: Any) -> bool:
-            if other.state != JOB_QUEUED:
-                return False  # a rolled-back admission's stale entry
+            if other.state != JOB_QUEUED or other.spec.kind != "pca":
+                return False  # stale entry / non-Gramian job kind
             try:
                 conf = job_config(other.spec, self._base)
                 return (
@@ -543,6 +545,10 @@ class AnalysisJobTier:
         # bit-identical — the manifest is deterministic).
         import os
 
+        if job.spec.kind != "pca":
+            # Read-scoring jobs have no Gramian to snapshot; replay
+            # just re-runs them (per-pair results are deterministic).
+            return None
         spec_vsids = job.spec.variant_set_ids or tuple(
             self._base.variant_set_ids
         )
@@ -606,11 +612,15 @@ class AnalysisJobTier:
         ckpt = self._ckpt_dir(job)
         try:
             with obs.span(
-                "job.run", job_id=job.id, tenant=job.spec.tenant
+                "job.run",
+                job_id=job.id,
+                tenant=job.spec.tenant,
+                kind=job.spec.kind,
             ):
                 faults.inject("serving.job.run", key=job.id)
                 rows = self._engine.run(
-                    job_config(job.spec, self._base, checkpoint_dir=ckpt)
+                    job_config(job.spec, self._base, checkpoint_dir=ckpt),
+                    kind=job.spec.kind,
                 )
         except Exception as e:  # noqa: BLE001 — job isolation boundary
             self._finish(job, error=f"{type(e).__name__}: {e}")

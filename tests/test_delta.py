@@ -463,3 +463,104 @@ class TestDeltaIndex:
         got = eng.run(tweaked)
         want = AnalysisEngine(src).run(tweaked)
         assert got == want
+
+
+class TestDeltaPersistence:
+    """ROADMAP item 1 remainder: DeltaIndex entries write through to
+    the journal directory and survive a kill -9 — with checksummed
+    re-load and a LOUD cold fallback for torn/stale files."""
+
+    def test_entries_survive_restart_and_serve_warm_deltas(self, tmp_path):
+        src = synthetic_cohort(8, 60, seed=4)
+        ids = [f"{DEFAULT_VARIANT_SET_ID}-{i}" for i in range(8)]
+        persist = str(tmp_path / "deltas")
+        eng = AnalysisEngine(
+            src, delta_max_samples=16, delta_persist_dir=persist
+        )
+        base = _conf()
+        eng.run(base)  # caches + persists the full-frame ancestor
+        import os
+
+        files = [f for f in os.listdir(persist) if f.endswith(".npz")]
+        assert files, "persisted entry expected beside the journal"
+        # "kill -9": a brand-new engine on the same directory must
+        # resolve the ancestor warm and serve the ±1 delta job
+        # bit-identically to a cold engine.
+        eng2 = AnalysisEngine(
+            src, delta_max_samples=16, delta_persist_dir=persist
+        )
+        tweaked = _conf(exclude_samples=[ids[3]])
+        assert eng2.delta_resolvable(tweaked)
+        got = eng2.run(tweaked)
+        want = AnalysisEngine(src).run(tweaked)
+        assert got == want  # exact float equality
+
+    def test_torn_and_stale_entries_fall_back_cold_loudly(
+        self, tmp_path, capsys
+    ):
+        import os
+
+        persist = str(tmp_path / "deltas")
+        idx = DeltaIndex(max_delta_samples=4, persist_dir=persist)
+        g = np.arange(9, dtype=np.float32).reshape(3, 3)
+        idx.put("k1", ("a", "b", "c"), g)
+        idx.put("k2", ("a", "b"), g[:2, :2].copy())
+        names = sorted(
+            f for f in os.listdir(persist) if f.endswith(".npz")
+        )
+        assert len(names) == 2
+        # Torn file (a kill mid-write after the atomic-rename window
+        # would leave a valid file; this models external truncation /
+        # partial disk): half the bytes.
+        torn = os.path.join(persist, names[0])
+        with open(torn, "r+b") as f:
+            f.truncate(os.path.getsize(torn) // 2)
+        # Stale file: valid npz whose G no longer matches its
+        # insert-time checksum.
+        stale = os.path.join(persist, names[1])
+        doc = dict(np.load(stale, allow_pickle=False))
+        doc["g"] = doc["g"] + 1.0
+        with open(stale, "wb") as f:
+            np.savez(f, **doc)
+        idx2 = DeltaIndex(max_delta_samples=4, persist_dir=persist)
+        err = capsys.readouterr().err
+        assert err.count("torn/stale delta-cache entry") == 2
+        assert len(idx2) == 0  # both dropped -> those cohorts run cold
+        assert not os.path.exists(torn) and not os.path.exists(stale)
+
+    def test_mid_write_partial_is_swept_never_parsed(self, tmp_path):
+        import os
+
+        persist = str(tmp_path / "deltas")
+        os.makedirs(persist)
+        # A kill mid-persist leaves only the .tmp- partial (the rename
+        # is atomic); a restart must sweep it silently.
+        with open(
+            os.path.join(persist, "delta-abc-def.npz.tmp-123"), "wb"
+        ) as f:
+            f.write(b"half a zip")
+        idx = DeltaIndex(max_delta_samples=4, persist_dir=persist)
+        assert len(idx) == 0
+        assert os.listdir(persist) == []
+
+    def test_drop_and_eviction_unlink_files(self, tmp_path):
+        import os
+
+        persist = str(tmp_path / "deltas")
+        g = np.ones((64, 64), dtype=np.float32)  # 16 KiB per entry
+        idx = DeltaIndex(
+            max_delta_samples=4,
+            max_bytes=64 * 1024,
+            persist_dir=persist,
+        )
+        for i in range(6):  # 6 x 16 KiB > 64 KiB budget -> evictions
+            idx.put(f"k{i}", (f"s{i}",), g)
+        on_disk = [f for f in os.listdir(persist) if f.endswith(".npz")]
+        assert len(on_disk) == len(idx) < 6
+        entry = idx.resolve("k5", ("s5",))
+        idx.drop(entry)
+        assert not os.path.exists(
+            os.path.join(
+                persist, DeltaIndex._entry_filename("k5", ("s5",))
+            )
+        )

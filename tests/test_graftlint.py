@@ -293,6 +293,18 @@ class TestSchemaSharing:
             "PR"
         )
 
+    def test_extracted_pairhmm_spans_match_schema_exactly(self, project):
+        schema = span_contract_mod.load_schema(REPO_ROOT)
+        extracted = {
+            name
+            for name in span_contract_mod.extract_span_names(project)
+            if name.startswith("pairhmm.")
+        }
+        assert extracted == set(schema._PAIRHMM_SPANS), (
+            "emitted pairhmm.* span literals and the validate_trace "
+            "schema diverged — change both sides in one PR"
+        )
+
     def test_contract_metrics_registered_with_required_labels(self, project):
         schema = span_contract_mod.load_schema(REPO_ROOT)
         regs = span_contract_mod.extract_metric_registrations(project)

@@ -1,0 +1,569 @@
+"""PairHMM read-level kernel subsystem: kernel parity, driver, serving.
+
+Four layers, mirroring the subsystem's structure:
+
+- **kernel** (ops/pairhmm.py): the batched anti-diagonal f32 forward
+  pass holds tolerance parity with the scalar float64 numpy golden
+  across length buckets, masked pads, and shuffled pair orders — the
+  acceptance contract of ISSUE 15;
+- **fixtures** (genomics/fixtures.synthetic_read_pairs): the
+  hand-computable pairs really are hand-computable (the closed-form
+  all-match path sum pins the match-kind likelihood to ~1%);
+- **driver** (models/pairhmm.py): consensus voting, bucketing, and the
+  completion-order feed produce rows bit-identical under any worker
+  count / batch size, with schema-valid telemetry;
+- **serving** (the `pairhmm` job kind): spec validation, result
+  caching, and the deterministic kill -9 → restart → identical-result
+  chaos pin the PCA kind has always had.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from spark_examples_tpu.genomics.fixtures import (
+    DEFAULT_VARIANT_SET_ID,
+    FIXTURE_READSET_ID,
+    synthetic_cohort,
+    synthetic_read_pairs,
+    synthetic_reads,
+)
+from spark_examples_tpu.ops.pairhmm import (
+    DEFAULT_GAP_EXT_PHRED,
+    DEFAULT_GAP_OPEN_PHRED,
+    PAIRHMM_FORWARD_ATOL,
+    PAIRHMM_FORWARD_RTOL,
+    PAIRHMM_NEG_INF,
+    pairhmm_bucket,
+    pairhmm_forward_batch,
+    pairhmm_forward_ref,
+)
+from spark_examples_tpu.utils.config import PcaConfig
+
+READS_REFS = "11:6888648:6890648"
+
+
+def _batch_arrays(pairs, r_bucket=None, h_bucket=None, b_pad=None):
+    """Stack (read, quals, hap) triples into padded kernel operands."""
+    r_b = r_bucket or pairhmm_bucket(max(p["read"].size for p in pairs))
+    h_b = h_bucket or pairhmm_bucket(max(p["hap"].size for p in pairs))
+    b = b_pad or len(pairs)
+    rc = np.zeros((b, r_b), np.int8)
+    rq = np.zeros((b, r_b), np.int32)
+    hc = np.full((b, h_b), 4, np.int8)
+    rl = np.zeros(b, np.int32)
+    hl = np.zeros(b, np.int32)
+    for k, p in enumerate(pairs):
+        rc[k, : p["read"].size] = p["read"]
+        rq[k, : p["quals"].size] = p["quals"]
+        hc[k, : p["hap"].size] = p["hap"]
+        rl[k] = p["read"].size
+        hl[k] = p["hap"].size
+    return rc, rq, rl, hc, hl
+
+
+def _random_pairs(rng, shapes, substring=True):
+    pairs = []
+    for rl, hl in shapes:
+        hap = rng.integers(0, 4, hl).astype(np.int8)
+        if substring and hl >= rl:
+            off = int(rng.integers(0, hl - rl + 1))
+            read = hap[off : off + rl].copy()
+            errs = rng.random(rl) < 0.05
+            read[errs] = rng.integers(0, 4, int(errs.sum()))
+        else:
+            read = rng.integers(0, 4, rl).astype(np.int8)
+        pairs.append(
+            {
+                "read": read.astype(np.int8),
+                "quals": rng.integers(5, 55, rl).astype(np.int32),
+                "hap": hap,
+            }
+        )
+    return pairs
+
+
+def _run_batch(pairs, **kw):
+    rc, rq, rl, hc, hl = _batch_arrays(pairs, **kw)
+    return np.asarray(
+        pairhmm_forward_batch(
+            rc,
+            rq,
+            rl,
+            hc,
+            hl,
+            np.float32(DEFAULT_GAP_OPEN_PHRED),
+            np.float32(DEFAULT_GAP_EXT_PHRED),
+        )
+    )
+
+
+def _assert_parity(out, pairs):
+    refs = np.array(
+        [
+            pairhmm_forward_ref(p["read"], p["quals"], p["hap"])
+            for p in pairs
+        ]
+    )
+    np.testing.assert_allclose(
+        out[: len(pairs)],
+        refs,
+        rtol=PAIRHMM_FORWARD_RTOL,
+        atol=PAIRHMM_FORWARD_ATOL,
+    )
+
+
+class TestKernelGoldenParity:
+    def test_matches_scalar_golden_across_length_buckets(self):
+        """The acceptance matrix: reads and haplotypes spanning several
+        pow2 buckets, every pair within the documented f32 tolerance of
+        the float64 golden."""
+        rng = np.random.default_rng(0)
+        shapes = [
+            (1, 1),
+            (1, 8),
+            (3, 5),
+            (7, 16),
+            (20, 33),
+            (37, 64),
+            (100, 116),
+            (100, 200),
+            (250, 300),
+        ]
+        pairs = _random_pairs(rng, shapes)
+        _assert_parity(_run_batch(pairs), pairs)
+
+    def test_masked_pads_do_not_leak_into_results(self):
+        """A pair's value must be identical whether it rides a tile
+        bucketed exactly to its length or one padded 4x wider/taller
+        with junk in the pad lanes — bit-for-bit, since masking (not
+        the pad contents) defines the matrix."""
+        rng = np.random.default_rng(1)
+        pairs = _random_pairs(rng, [(9, 12), (17, 40), (64, 80)])
+        tight = _run_batch(pairs)
+        r_b = pairhmm_bucket(64) * 4
+        h_b = pairhmm_bucket(80) * 4
+        rc, rq, rl, hc, hl = _batch_arrays(
+            pairs, r_bucket=r_b, h_bucket=h_b, b_pad=8
+        )
+        # Poison every pad lane: masked geometry must ignore it.
+        for k, p in enumerate(pairs):
+            rc[k, p["read"].size :] = 2
+            rq[k, p["read"].size :] = 60
+            hc[k, p["hap"].size :] = 1
+        wide = np.asarray(
+            pairhmm_forward_batch(
+                rc,
+                rq,
+                rl,
+                hc,
+                hl,
+                np.float32(DEFAULT_GAP_OPEN_PHRED),
+                np.float32(DEFAULT_GAP_EXT_PHRED),
+            )
+        )
+        np.testing.assert_array_equal(tight, wide[: len(pairs)])
+        _assert_parity(wide, pairs)
+        # Padded batch slots report the sentinel, never a number that
+        # could be mistaken for a score.
+        assert (wide[len(pairs) :] <= PAIRHMM_NEG_INF / 2).all()
+
+    def test_shuffled_pair_order_is_bit_identical(self):
+        """Per-pair results are elementwise along the batch axis: any
+        permutation of the tile permutes the outputs exactly."""
+        rng = np.random.default_rng(2)
+        pairs = _random_pairs(rng, [(25, 40)] * 12)
+        base = _run_batch(pairs)
+        perm = rng.permutation(len(pairs))
+        shuffled = _run_batch([pairs[i] for i in perm])
+        np.testing.assert_array_equal(base[perm], shuffled)
+
+    def test_n_bases_never_match(self):
+        """Code 4 (N) on either side scores as a mismatch — including a
+        consensus hole (all-N haplotype)."""
+        quals = np.full(4, 30, np.int32)
+        read = np.array([0, 1, 2, 3], np.int8)
+        hap_n = np.full(8, 4, np.int8)
+        out = _run_batch(
+            [{"read": read, "quals": quals, "hap": hap_n}]
+        )
+        ref = pairhmm_forward_ref(read, quals, hap_n)
+        np.testing.assert_allclose(
+            out[0], ref, rtol=PAIRHMM_FORWARD_RTOL, atol=PAIRHMM_FORWARD_ATOL
+        )
+        # And strictly below the same read against a matching hap.
+        hap_m = np.array([0, 1, 2, 3, 0, 0, 0, 0], np.int8)
+        out_m = _run_batch(
+            [{"read": read, "quals": quals, "hap": hap_m}]
+        )
+        assert out[0] < out_m[0]
+
+    def test_likelihood_orders_edit_structures(self):
+        """More damage, less likelihood: exact match > one mismatch,
+        and every structured pair stays golden-parity."""
+        pairs = synthetic_read_pairs(8, read_len=8, hap_len=14, seed=3)
+        out = _run_batch(pairs)
+        _assert_parity(out, pairs)
+        by_kind = {}
+        for p, v in zip(pairs, out):
+            by_kind.setdefault(p["kind"], []).append(float(v))
+        assert max(by_kind["mismatch"]) < max(by_kind["match"])
+
+    def test_bucket_helper(self):
+        assert pairhmm_bucket(0) == 8
+        assert pairhmm_bucket(8) == 8
+        assert pairhmm_bucket(9) == 16
+        assert pairhmm_bucket(100) == 128
+        assert pairhmm_bucket(3, floor=1) == 4
+        assert pairhmm_bucket(1, floor=1) == 1
+
+
+class TestSyntheticReadPairs:
+    def test_match_kind_matches_hand_formula(self):
+        """The whole point of the fixture: a reviewer can compute the
+        match-kind likelihood on paper. The all-match path sum
+        (h-r+1)·(1/h)·(1-2ε_go)^(r-1)·(1-ε)^r is a lower bound within
+        ~1% of the full forward value at these shapes."""
+        pairs = [
+            p
+            for p in synthetic_read_pairs(
+                12, read_len=6, hap_len=10, quality=20, seed=0
+            )
+            if p["kind"] == "match"
+        ]
+        assert pairs
+        eps = 10.0 ** (-20 / 10.0)
+        eps_go = 10.0 ** (-DEFAULT_GAP_OPEN_PHRED / 10.0)
+        eps_ge = 10.0 ** (-DEFAULT_GAP_EXT_PHRED / 10.0)
+        for p in pairs:
+            r, h = p["read"].size, p["hap"].size
+            # Count the offsets where the read really is an exact
+            # substring (the drawn hap may repeat the motif).
+            n_off = sum(
+                1
+                for off in range(h - r + 1)
+                if (p["hap"][off : off + r] == p["read"]).all()
+            )
+            hand = (
+                np.log(n_off)
+                - np.log(h)
+                + np.log1p(-eps_ge)  # D(free start) -> M gap close
+                + (r - 1) * np.log1p(-2 * eps_go)
+                + r * np.log1p(-eps)
+            )
+            full = pairhmm_forward_ref(p["read"], p["quals"], p["hap"])
+            assert hand <= full + 1e-12
+            assert abs(full - hand) < 0.01 * abs(hand) + 0.02
+
+    def test_deterministic_and_structured(self):
+        a = synthetic_read_pairs(8, seed=5)
+        b = synthetic_read_pairs(8, seed=5)
+        for pa, pb in zip(a, b):
+            assert pa["name"] == pb["name"]
+            np.testing.assert_array_equal(pa["read"], pb["read"])
+            np.testing.assert_array_equal(pa["hap"], pb["hap"])
+        kinds = {p["kind"] for p in a}
+        assert kinds == {"match", "mismatch", "insert", "delete"}
+        for p in a:
+            assert p["read"].size == 6 and p["hap"].size == 10
+
+    def test_rejects_impossible_geometry(self):
+        with pytest.raises(ValueError, match="must exceed"):
+            synthetic_read_pairs(2, read_len=8, hap_len=8)
+
+
+def _driver_conf(**kw):
+    base = dict(
+        references=READS_REFS,
+        bases_per_partition=500,
+        read_group_set_id=FIXTURE_READSET_ID,
+    )
+    base.update(kw)
+    return PcaConfig(**base)
+
+
+class TestPairHmmDriver:
+    def test_scores_every_read_bit_identical_across_feeds(self):
+        """Worker count and batch size change only wall-clock: the
+        emitted rows (names, f32 log-likelihoods, buckets) are
+        EXACTLY equal — the completion-order feed's contract."""
+        from spark_examples_tpu.models.pairhmm import PairHmmDriver
+
+        src = synthetic_reads(90, references=READS_REFS, seed=4)
+        base = PairHmmDriver(_driver_conf(), src).run_rows()
+        assert len(base) == 90
+        assert base == sorted(base, key=lambda r: r[0])
+        for workers, batch in ((1, 128), (3, 128), (3, 9), (2, 1)):
+            rows = PairHmmDriver(
+                _driver_conf(ingest_workers=workers, pairhmm_batch=batch),
+                src,
+            ).run_rows()
+            assert rows == base
+
+    def test_consensus_recovers_latent_haplotype_scores(self):
+        """With enough coverage the consensus equals the latent
+        haplotype, so an error-free read scores near the hand formula
+        for a perfect substring — the fixture/driver loop closes."""
+        from spark_examples_tpu.models.pairhmm import (
+            PairHmmDriver,
+            consensus_haplotype,
+        )
+
+        src = synthetic_reads(300, references=READS_REFS, seed=0)
+        rows = PairHmmDriver(_driver_conf(), src).run_rows()
+        scored = [r for r in rows if r[1] > PAIRHMM_NEG_INF / 2]
+        assert len(scored) == 300
+        # ~1% base error at Q~35: the bulk of reads should sit near
+        # the few-errors regime, far above a random-sequence score.
+        med = float(np.median([r[1] for r in scored]))
+        assert -40.0 < med < 0.0
+        # consensus_haplotype with zero coverage holds N (code 4).
+        hole = consensus_haplotype([], 0, 16)
+        assert (hole == 4).all()
+
+    def test_empty_readset_warns_not_raises(self, capsys):
+        from spark_examples_tpu.models.pairhmm import PairHmmDriver
+
+        src = synthetic_reads(0, references=READS_REFS)
+        rows = PairHmmDriver(_driver_conf(), src).run(out_path=None)
+        assert rows == []
+        assert "no read x haplotype pairs" in capsys.readouterr().err
+
+    def test_flag_validation_is_loud(self):
+        from spark_examples_tpu.models.pairhmm import PairHmmDriver
+
+        src = synthetic_reads(1, references=READS_REFS)
+        for kw, msg in (
+            ({"pairhmm_batch": 0}, "pairhmm_batch"),
+            ({"pairhmm_context": -1}, "pairhmm_context"),
+            ({"pairhmm_gap_open_phred": 0.0}, "gap_open"),
+            # At or below 10*log10(2) ~= 3.01 the M->M transition
+            # probability is non-positive and every likelihood would
+            # be NaN — rejected at the boundary, never a NaN sea.
+            ({"pairhmm_gap_open_phred": 3.0}, "NaN"),
+            ({"pairhmm_gap_ext_phred": -3.0}, "gap_ext"),
+        ):
+            with pytest.raises(ValueError, match=msg):
+                PairHmmDriver(_driver_conf(**kw), src)
+
+    def test_cli_run_emits_schema_valid_telemetry(self, tmp_path):
+        """A real `cli pairhmm` run (the CI leg's shape): artifacts
+        validate, the pairhmm spans and the bucket-labeled pair counter
+        are present, and the score dump is written."""
+        import scripts.validate_trace as validate
+        from spark_examples_tpu.cli.main import main
+
+        trace = str(tmp_path / "p.trace.json")
+        metrics = str(tmp_path / "p.metrics.prom")
+        rc = main(
+            [
+                "pairhmm",
+                "--fixture-reads",
+                "40",
+                "--bases-per-partition",
+                "1000",
+                "--output-path",
+                str(tmp_path),
+                "--trace-out",
+                trace,
+                "--metrics-out",
+                metrics,
+            ]
+        )
+        assert rc == 0
+        assert validate.validate_trace(trace) == []
+        assert validate.validate_metrics(metrics) == []
+        events = json.loads(open(trace).read())["traceEvents"]
+        names = {e["name"] for e in events}
+        assert {"pairhmm.bucket", "pairhmm.forward"} <= names
+        prom = open(metrics).read()
+        assert 'pairhmm_pairs_total{bucket="' in prom
+        out = (tmp_path / "pairhmm_scores" / "part-00000").read_text()
+        assert len(out.strip().splitlines()) == 40
+
+    def test_schema_rejects_unknown_pairhmm_span(self, tmp_path):
+        """Drift gate, rejection direction: a renamed pairhmm span
+        fails validate_trace (GL003 holds the other direction)."""
+        import scripts.validate_trace as validate
+
+        path = tmp_path / "bad.trace.json"
+        path.write_text(
+            json.dumps(
+                {
+                    "traceEvents": [
+                        {
+                            "ph": "X",
+                            "name": "pairhmm.scoar",
+                            "pid": 1,
+                            "ts": 0,
+                            "dur": 1,
+                        }
+                    ]
+                }
+            )
+        )
+        errs = validate.validate_trace(str(path))
+        assert errs and "unknown pairhmm span" in errs[0]
+
+
+def _serving_fixture():
+    src = synthetic_cohort(12, 120, references=READS_REFS, seed=2)
+    src.add_reads(
+        synthetic_reads(50, references=READS_REFS, seed=6).reads_records()
+    )
+    base = PcaConfig(
+        variant_set_ids=[DEFAULT_VARIANT_SET_ID],
+        references=READS_REFS,
+        bases_per_partition=1000,
+    )
+    return src, base
+
+
+class TestPairhmmJobKind:
+    def test_spec_validation(self):
+        from spark_examples_tpu.serving import JobSpec
+
+        spec = JobSpec.from_record(
+            {"kind": "pairhmm", "read_group_set_id": FIXTURE_READSET_ID}
+        )
+        assert spec.kind == "pairhmm"
+        rec = spec.to_record()
+        assert rec["kind"] == "pairhmm"
+        assert "variant_set_ids" not in rec
+        assert JobSpec.from_record(rec) == spec  # journal round-trip
+        with pytest.raises(ValueError, match="unknown job kind"):
+            JobSpec.from_record({"kind": "bwa"})
+        with pytest.raises(ValueError, match="do not apply"):
+            JobSpec.from_record({"kind": "pairhmm", "num_pc": 3})
+        with pytest.raises(ValueError, match="only to pairhmm"):
+            JobSpec.from_record({"read_group_set_id": "x"})
+        # Default kind keeps the historical record shape and keys.
+        assert "kind" not in JobSpec().to_record()
+
+    def test_pairhmm_job_runs_caches_and_isolates_from_pca(self):
+        from spark_examples_tpu.serving import (
+            AnalysisEngine,
+            AnalysisJobTier,
+            JobSpec,
+        )
+
+        src, base = _serving_fixture()
+        tier = AnalysisJobTier(AnalysisEngine(src), base, workers=0)
+        spec = JobSpec.from_record(
+            {"kind": "pairhmm", "read_group_set_id": FIXTURE_READSET_ID}
+        )
+        job, created = tier.submit(spec)
+        assert created
+        while tier.step(timeout=0.0):
+            pass
+        assert job.state == "done", job.error
+        assert len(job.result) == 50
+        name, loglik, bucket = job.result[0]
+        assert isinstance(loglik, float) and bucket.startswith("r")
+        # Identical resubmission: result cache, no new work.
+        again, created2 = tier.submit(spec)
+        assert not created2 and again.cached
+        assert again.result == job.result
+        # A PCA job on the same tier still runs (and its key space
+        # never collides with the pairhmm kind's).
+        pca_job, _ = tier.submit(JobSpec.from_record({}))
+        while tier.step(timeout=0.0):
+            pass
+        assert pca_job.state == "done", pca_job.error
+        assert pca_job.key != job.key
+        tier.close()
+
+    def test_pairhmm_jobs_never_gang(self):
+        """Gang coalescing is a Gramian-stack optimization; a pairhmm
+        lead (or member) must run solo even with gangs armed."""
+        from spark_examples_tpu.serving import (
+            AnalysisEngine,
+            AnalysisJobTier,
+            JobSpec,
+        )
+
+        src, base = _serving_fixture()
+        tier = AnalysisJobTier(
+            AnalysisEngine(src), base, workers=0, gang_max_samples=256
+        )
+        phmm = JobSpec.from_record(
+            {"kind": "pairhmm", "read_group_set_id": FIXTURE_READSET_ID}
+        )
+        jobs = [tier.submit(phmm)[0]]
+        jobs.append(
+            tier.submit(
+                JobSpec.from_record(
+                    {"kind": "pairhmm", "references": READS_REFS}
+                )
+            )[0]
+        )
+        while tier.step(timeout=0.0):
+            pass
+        assert all(j.state == "done" for j in jobs), [
+            j.error for j in jobs
+        ]
+        tier.close()
+
+    def test_kill_nine_restart_identical_result(self, tmp_path):
+        """ISSUE 15 acceptance: a pairhmm job killed between the
+        journaled start and execution re-queues on restart and re-runs
+        to the EXACT same rows — the same chaos pin the PCA kind
+        carries (exact float equality, deterministic f32 kernel)."""
+        from spark_examples_tpu.resilience import faults
+        from spark_examples_tpu.resilience.faults import (
+            FaultPlan,
+            FaultRule,
+        )
+        from spark_examples_tpu.serving import (
+            AnalysisEngine,
+            AnalysisJobTier,
+            JobSpec,
+            SimulatedCrash,
+        )
+
+        src, base = _serving_fixture()
+        spec = JobSpec.from_record(
+            {"kind": "pairhmm", "read_group_set_id": FIXTURE_READSET_ID}
+        )
+        # Baseline rows from a journal-less tier on the same source.
+        solo = AnalysisJobTier(AnalysisEngine(src), base, workers=0)
+        ref_job, _ = solo.submit(spec)
+        while solo.step(timeout=0.0):
+            pass
+        assert ref_job.state == "done", ref_job.error
+        solo.close()
+
+        journal = str(tmp_path / "journal")
+        tier = AnalysisJobTier(
+            AnalysisEngine(src), base, workers=0, journal_dir=journal
+        )
+        plan = FaultPlan(
+            seed=1,
+            rules=[
+                FaultRule(site="serving.job.kill", kind="error", times=1)
+            ],
+        )
+        with faults.active_plan(plan):
+            job, created = tier.submit(spec)
+            assert created
+            with pytest.raises(SimulatedCrash):
+                tier.step(timeout=1.0)
+        assert job.state == "running"  # abandoned, as a SIGKILL leaves it
+        tier2 = AnalysisJobTier(
+            AnalysisEngine(src), base, workers=0, journal_dir=journal
+        )
+        resumed = tier2.job(job.id)
+        assert resumed is not None and resumed.state == "queued"
+        assert tier2.step(timeout=1.0)
+        assert resumed.state == "done", resumed.error
+        assert resumed.result == ref_job.result  # exact equality
+        tier2.close()
+        # And a third tier replays the DONE job straight into the
+        # cache — kill -9 after completion loses nothing either.
+        tier3 = AnalysisJobTier(
+            AnalysisEngine(src), base, workers=0, journal_dir=journal
+        )
+        cached, created3 = tier3.submit(spec)
+        assert not created3 and cached.result == ref_job.result
+        tier3.close()

@@ -271,3 +271,93 @@ class TestShardBoundaryCarry:
             )
             contents.append(open(out).read())
         assert contents[0] == contents[1]
+
+
+class TestComputeHarnessEdges:
+    """Direct edge-case coverage for the per-shard compute harness in
+    models/search_reads.py (`compute(shard, reads, pad)` through
+    `_windowed_arrays`) and the `_pad_pow2` bucketing — the paths the
+    whole-pipeline tests exercise only in aggregate."""
+
+    def test_pad_pow2_floor_growth_and_exact_powers(self):
+        from spark_examples_tpu.models.search_reads import _pad_pow2
+
+        assert _pad_pow2(0) == 256  # the floor, even for nothing
+        assert _pad_pow2(1) == 256
+        assert _pad_pow2(256) == 256  # exact power stays put
+        assert _pad_pow2(257) == 512  # one past doubles
+        assert _pad_pow2(5000) == 8192
+        assert _pad_pow2(3, floor=64) == 64
+        assert _pad_pow2(65, floor=64) == 128
+
+    def test_empty_shard_yields_zero_window_and_no_lines(self, tmp_path):
+        """A shard with no reads must flow through the harness as an
+        all-zero window (not crash, not emit) — the empty-region case."""
+        from spark_examples_tpu.genomics.fixtures import FixtureSource
+
+        src = FixtureSource(reads=[])
+        out = per_base_depth_example(
+            src,
+            "",
+            references="21:1000:3000",
+            out_path=str(tmp_path),
+            bases_per_shard=500,
+        )
+        assert open(out).read() == ""
+
+    def test_single_read_depth_is_one_over_its_span(self, tmp_path):
+        from spark_examples_tpu.genomics.fixtures import FixtureSource
+
+        src = FixtureSource(
+            reads=[
+                {
+                    "reference_name": "21",
+                    "position": 1500,
+                    "aligned_sequence": "ACGT" * 10,
+                    "aligned_quality": [30] * 40,
+                    "cigar_ops": [("ALIGNMENT_MATCH", 40)],
+                    "mapping_quality": 50,
+                    "fragment_name": "only-read",
+                    "read_group_set_id": "rg",
+                }
+            ]
+        )
+        out = per_base_depth_example(
+            src,
+            "rg",
+            references="21:1000:3000",
+            out_path=str(tmp_path),
+            bases_per_shard=500,
+        )
+        lines = open(out).read().strip().splitlines()
+        assert lines == [f"({p},1)" for p in range(1500, 1540)]
+
+    def test_pad_growth_read_longer_than_shard_carries_over(self, tmp_path):
+        """A read LONGER than its whole shard forces the compute
+        window's pad to grow past the shard range; the overhang must
+        carry into the next window, independent of shard size."""
+        from spark_examples_tpu.genomics.fixtures import FixtureSource
+
+        read_len = 700  # > bases_per_shard below
+        rec = {
+            "reference_name": "21",
+            "position": 1100,
+            "aligned_sequence": "A" * read_len,
+            "aligned_quality": [30] * read_len,
+            "cigar_ops": [("ALIGNMENT_MATCH", read_len)],
+            "mapping_quality": 50,
+            "fragment_name": "long-read",
+            "read_group_set_id": "rg",
+        }
+        expected = [
+            f"({p},1)" for p in range(1100, 1100 + read_len)
+        ]
+        for shard_size in (200, 500, 5000):
+            out = per_base_depth_example(
+                FixtureSource(reads=[rec]),
+                "rg",
+                references="21:1000:4000",
+                out_path=str(tmp_path / f"s{shard_size}"),
+                bases_per_shard=shard_size,
+            )
+            assert open(out).read().strip().splitlines() == expected

@@ -63,6 +63,7 @@ DEFAULT_BUCKET_HELPERS = (
     "_carrier_bucket",
     "_pad_rows_for_scan",
     "_pow2_rows",
+    "pairhmm_bucket",
     "randomized_panel_width",
     "round_up_multiple",
 )

@@ -155,8 +155,8 @@ class SpanContractRule:
     name = NAME
     code = CODE
     summary = (
-        "spans are context-managed; ingest.*/job.*/gramian.sparse.* "
-        "span names and wire/ingest/serving/sparse metric "
+        "spans are context-managed; ingest.*/job.*/gramian.sparse.*/"
+        "pairhmm.* span names and wire/ingest/serving/sparse metric "
         "registrations match scripts/validate_trace.py exactly"
     )
     project_wide = True
@@ -194,6 +194,7 @@ class SpanContractRule:
             ("ingest.", "_INGEST_SPANS"),
             ("job.", "_JOB_SPANS"),
             ("gramian.sparse.", "_SPARSE_SPANS"),
+            ("pairhmm.", "_PAIRHMM_SPANS"),
         ):
             emitted = {n for n in span_names if n.startswith(prefix)}
             schema_spans: Set[str] = set(getattr(schema, attr, set()))
