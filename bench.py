@@ -1138,6 +1138,63 @@ def serving_bench():
                 "gang-batched results diverged from serial — refusing "
                 "to report throughput for wrong answers"
             )
+            # Introspection-plane leg (docs/OBSERVABILITY.md §live
+            # endpoints): the /metrics exposition itself, and its cost
+            # to the hot path. Two numbers: scrape latency quantiles
+            # over the registry the soaks just populated, and the
+            # serial soak re-run under a 1 Hz background scraper — the
+            # Prometheus cadence — whose throughput must be unchanged
+            # (zero-delta pin; the scrape path takes only per-metric
+            # locks, never the tier lock).
+            import threading as _threading
+
+            from spark_examples_tpu import obs as _obs
+
+            reg = _obs.get_registry()
+            lat = []
+            for _ in range(200):
+                t0 = time.perf_counter()
+                reg.to_prometheus()
+                lat.append(time.perf_counter() - t0)
+            lat.sort()
+            scrape_p50_ms = lat[len(lat) // 2] * 1e3
+            scrape_p99_ms = lat[min(len(lat) - 1, (len(lat) * 99) // 100)] * 1e3
+
+            def soak_scraped():
+                stop = _threading.Event()
+
+                def scrape_loop():
+                    while True:
+                        reg.to_prometheus()
+                        if stop.wait(1.0):
+                            return
+
+                t = _threading.Thread(target=scrape_loop, daemon=True)
+                t.start()
+                try:
+                    return soak(gang_max=0)
+                finally:
+                    stop.set()
+                    t.join()
+
+            # Adjacent baseline: t_serial above may have absorbed a
+            # late compile (the near-degenerate retry executable), so
+            # the overhead ratio compares against a fresh no-scraper
+            # soak measured back to back with the scraped ones.
+            plain_runs = [soak(gang_max=0) for _ in range(repeat)]
+            t_plain = min(r[0] for r in plain_runs)
+            scraped_runs = [soak_scraped() for _ in range(repeat)]
+            t_scraped, rows_scraped = min(scraped_runs, key=lambda r: r[0])
+            assert rows_scraped == rows_serial, (
+                "results changed under a background /metrics scraper — "
+                "observation must not perturb the system"
+            )
+            scrape_overhead = t_scraped / t_plain
+            assert scrape_overhead <= 1.5, (
+                f"1 Hz /metrics scraper cost {scrape_overhead:.2f}x on "
+                "serving throughput (best-of-N) — the scrape path is "
+                "supposed to be off the hot path entirely"
+            )
             # Delta leg: ancestor cohort cached, then the ±16 tweak.
             anc = tuple(sorted(ids[:cohort_n]))
             tweak = tuple(
@@ -1210,6 +1267,9 @@ def serving_bench():
                 "delta_seconds": round(t_delta, 4),
                 "delta_speedup": round(t_cold / t_delta, 3),
                 "delta_samples_changed": delta_k,
+                "metrics_scrape_p50_ms": round(scrape_p50_ms, 4),
+                "metrics_scrape_p99_ms": round(scrape_p99_ms, 4),
+                "scrape_overhead_ratio": round(scrape_overhead, 3),
                 "bit_identical": True,
                 "backend": (
                     "cpu-fallback" if fallback else jax.default_backend()

@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Merge per-process pod traces into one clock-aligned Perfetto timeline.
+
+Each pod process writes its own Chrome trace (``--trace-out``), and each
+trace's timestamps are relative to that process's OWN tracer start on
+its OWN clock — loading them side by side shows N unrelated time axes.
+This tool aligns them: the ``pod.exchange_ts`` instants recorded by the
+header exchange (parallel/podstream.py) carry each process's wall-clock
+send/receive timestamps per protocol step, which is exactly an NTP-style
+symmetric round trip. For processes A and B with A's pair
+``(send_a, recv_a)`` and B's mirror pair ``(send_b, recv_b)`` at the
+same (stream, step), the midpoint estimate of B's clock offset
+relative to A is::
+
+    theta = ((recv_b - send_a) + (send_b - recv_a)) / 2
+
+— transit delays cancel to first order. The per-peer offset is the
+MEDIAN of the per-step estimates (robust to a straggler step), offsets
+compose transitively through the exchange graph for processes that
+never talked directly, and every event is shifted onto the reference
+process's clock. The merged file keeps one Perfetto track group per
+process (distinct pid + ``process_name`` metadata).
+
+The merged timeline is where the pipelining overlap proof becomes
+cross-process checkable: :func:`merged_overlap_proven` asserts some
+step w+1 exchange on one process begins before step w's window span
+ends on a DIFFERENT process — the claim the per-process predicate
+(validate_trace.py's ``sparse_overlap_proven``) cannot express.
+
+Usage::
+
+    python scripts/merge_pod_trace.py -o merged.json p0.json p1.json
+    python scripts/merge_pod_trace.py --assert-overlap -o merged.json \
+        p0.json p1.json
+
+Stdlib only — runs anywhere, including images without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "clock_offsets",
+    "merge_traces",
+    "merged_overlap_proven",
+    "main",
+]
+
+
+def _load(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(f"{path}: expected object with 'traceEvents'")
+    return doc
+
+
+def _proc_key(doc: Dict[str, Any], idx: int) -> int:
+    """Stable identity for one input trace: its jax process index when
+    recorded, else its position on the command line."""
+    other = doc.get("otherData", {})
+    try:
+        return int(other["process_index"])
+    except (KeyError, TypeError, ValueError):
+        return idx
+
+
+def _exchange_pairs(
+    doc: Dict[str, Any],
+) -> Dict[Tuple[int, Any, int], Tuple[float, float]]:
+    """(peer, stream, step) -> (send_unix, recv_unix) for one trace."""
+    pairs: Dict[Tuple[int, Any, int], Tuple[float, float]] = {}
+    for ev in doc["traceEvents"]:
+        if ev.get("name") != "pod.exchange_ts":
+            continue
+        args = ev.get("args", {})
+        try:
+            key = (
+                int(args["peer"]),
+                args.get("stream"),
+                int(args["step"]),
+            )
+            pairs[key] = (
+                float(args["send_unix"]),
+                float(args["recv_unix"]),
+            )
+        except (KeyError, TypeError, ValueError):
+            continue
+    return pairs
+
+
+def clock_offsets(docs: List[Dict[str, Any]]) -> Dict[int, float]:
+    """Per-process clock offset (seconds) relative to the reference
+    process — the LOWEST process key, usually pod process 0. Offsets
+    are estimated pairwise by the midpoint method (median across steps)
+    and composed transitively (breadth-first) for processes with no
+    direct exchange record against the reference."""
+    keys = [_proc_key(doc, i) for i, doc in enumerate(docs)]
+    if len(set(keys)) != len(keys):
+        raise ValueError(
+            f"duplicate process identities {keys}: traces must come "
+            "from distinct pod processes"
+        )
+    pairs_by_proc = {
+        k: _exchange_pairs(doc) for k, doc in zip(keys, docs)
+    }
+    # theta[(a, b)] = b's clock minus a's clock.
+    theta: Dict[Tuple[int, int], float] = {}
+    for a in keys:
+        for b in keys:
+            if a >= b:
+                continue
+            estimates: List[float] = []
+            for (peer, stream, step), (
+                send_a,
+                recv_a,
+            ) in pairs_by_proc[a].items():
+                if peer != b:
+                    continue
+                mirror = pairs_by_proc[b].get((a, stream, step))
+                if mirror is None:
+                    continue
+                send_b, recv_b = mirror
+                estimates.append(
+                    ((recv_b - send_a) + (send_b - recv_a)) / 2.0
+                )
+            if estimates:
+                theta[(a, b)] = statistics.median(estimates)
+                theta[(b, a)] = -theta[(a, b)]
+    ref = min(keys)
+    offsets: Dict[int, float] = {ref: 0.0}
+    frontier = [ref]
+    while frontier:
+        a = frontier.pop(0)
+        for b in keys:
+            if b in offsets or (a, b) not in theta:
+                continue
+            offsets[b] = offsets[a] + theta[(a, b)]
+            frontier.append(b)
+    missing = [k for k in keys if k not in offsets]
+    if missing:
+        raise ValueError(
+            f"no pod.exchange_ts path links process(es) {missing} to "
+            f"process {ref}: cannot align clocks — was the trace "
+            "captured with telemetry active on every process?"
+        )
+    return offsets
+
+
+def merge_traces(paths: List[str]) -> Dict[str, Any]:
+    """Merged clock-aligned Chrome trace document for ``paths``."""
+    docs = [_load(p) for p in paths]
+    keys = [_proc_key(doc, i) for i, doc in enumerate(docs)]
+    offsets = clock_offsets(docs)
+    merged: List[Dict[str, Any]] = []
+    starts: List[float] = []
+    for key, doc in zip(keys, docs):
+        epoch = float(doc.get("otherData", {}).get("trace_epoch_unix", 0.0))
+        # Reference-clock wall time of this trace's ts=0.
+        starts.append(epoch - offsets[key])
+    base = min(starts)
+    for key, doc, start in zip(keys, docs, starts):
+        other = doc.get("otherData", {})
+        pid = key
+        shift_us = (start - base) * 1e6
+        host = other.get("host", "?")
+        merged.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {
+                    "name": (
+                        f"process {key} @ {host} "
+                        f"(os pid {other.get('pid', '?')}, "
+                        f"offset {offsets[key] * 1e3:+.3f} ms)"
+                    )
+                },
+            }
+        )
+        for ev in doc["traceEvents"]:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue  # superseded by the provenance name above
+            out = dict(ev)
+            out["pid"] = pid
+            if isinstance(out.get("ts"), (int, float)):
+                out["ts"] = float(out["ts"]) + shift_us
+            merged.append(out)
+    merged.sort(key=lambda ev: float(ev.get("ts", 0.0)))
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "spark_examples_tpu merge_pod_trace",
+            "processes": len(docs),
+            "offsets_ms": {
+                str(k): offsets[k] * 1e3 for k in sorted(offsets)
+            },
+        },
+    }
+
+
+def merged_overlap_proven(events: List[Dict[str, Any]]) -> bool:
+    """True when some step w+1 exchange span begins on one process
+    before step w's window span ends on a DIFFERENT process — the
+    cross-process form of the pipelining overlap proof, only decidable
+    on a clock-aligned merged timeline. Scoped per stream like the
+    single-process predicate (step numbers restart per stream)."""
+    window_end: Dict[Any, List[Tuple[float, Any]]] = {}
+    for ev in events:
+        if (
+            ev.get("ph") == "X"
+            and ev.get("name") == "gramian.sparse.window"
+        ):
+            args = ev.get("args", {})
+            step = args.get("step")
+            if step is not None:
+                key = (args.get("stream"), int(step))
+                window_end.setdefault(key, []).append(
+                    (ev["ts"] + ev["dur"], ev.get("pid"))
+                )
+    for ev in events:
+        if (
+            ev.get("ph") == "X"
+            and ev.get("name") == "gramian.sparse.allgather"
+        ):
+            args = ev.get("args", {})
+            prev = (args.get("stream"), int(args.get("step", 0)) - 1)
+            for end, pid in window_end.get(prev, []):
+                if pid != ev.get("pid") and ev["ts"] < end:
+                    return True
+    return False
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=(
+            "Merge per-process pod traces into one clock-aligned "
+            "Perfetto timeline"
+        )
+    )
+    p.add_argument("traces", nargs="+", help="per-process trace JSONs")
+    p.add_argument(
+        "-o", "--out", required=True, help="merged trace output path"
+    )
+    p.add_argument(
+        "--assert-overlap",
+        action="store_true",
+        help=(
+            "exit non-zero unless the cross-process pipelining overlap "
+            "proof holds on the merged timeline"
+        ),
+    )
+    args = p.parse_args(argv)
+    if len(args.traces) < 2:
+        p.error("need at least two per-process traces to merge")
+    try:
+        merged = merge_traces(args.traces)
+    except (OSError, ValueError) as e:
+        print(f"merge failed: {e}", file=sys.stderr)
+        return 1
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    offsets = merged["otherData"]["offsets_ms"]
+    print(
+        f"merged {len(args.traces)} trace(s), "
+        f"{len(merged['traceEvents'])} events -> {args.out}; "
+        "offsets (ms): "
+        + ", ".join(f"p{k}={v:+.3f}" for k, v in offsets.items())
+    )
+    if args.assert_overlap:
+        if not merged_overlap_proven(merged["traceEvents"]):
+            print(
+                "cross-process overlap NOT proven on the merged "
+                "timeline: no step w+1 exchange starts before a "
+                "different process's step w window ends",
+                file=sys.stderr,
+            )
+            return 1
+        print("cross-process pipelining overlap proven.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

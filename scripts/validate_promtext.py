@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Schema-check a live ``GET /metrics`` scrape (Prometheus text 0.0.4).
+
+The file-artifact checker (``validate_trace.py --metrics``) validates
+what a telemetry session WROTE; this tool validates what the server's
+introspection plane SERVES — CI scrapes ``/metrics`` mid-run and pipes
+the body through here. It is a thin wrapper over validate_trace.py's
+exposition checks on purpose: the metric name-sets and label contracts
+(``_LABELED_COUNTERS``, ``_SERVING_HISTOGRAMS``, ``_SERVING_GAUGES``,
+wire/ingest contracts) live in ONE module, so a schema change can never
+leave the scrape checker and the artifact checker disagreeing.
+
+On top of the shared line/label checks, a live scrape must also be
+self-describing: every sample family needs its ``# TYPE`` comment
+(the registry's exposition always emits HELP+TYPE, so a missing TYPE
+means the body was truncated or hand-assembled).
+
+Usage::
+
+    curl -fsS http://127.0.0.1:8080/metrics | \
+        python scripts/validate_promtext.py -
+    python scripts/validate_promtext.py scrape.prom
+
+Exit status is non-zero if the exposition fails, one line per problem
+on stderr. Stdlib only — runs anywhere, including images without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import re
+import sys
+from typing import Any, List
+
+__all__ = ["validate_prom_text", "main"]
+
+
+def _load_validate_trace() -> Any:
+    """Path-import the sibling artifact checker (scripts/ is not a
+    package; this mirrors how the tier-1 tests load it)."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "validate_trace.py"
+    )
+    spec = importlib.util.spec_from_file_location("validate_trace", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_vt = _load_validate_trace()
+
+_NAME = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+
+
+def _family(name: str) -> str:
+    """Sample name -> metric family (strip histogram series suffixes)."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def validate_prom_text(text: str, where: str = "<scrape>") -> List[str]:
+    """Errors for one exposition body ([] = valid)."""
+    errors: List[str] = []
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if not lines:
+        return [f"{where}: empty exposition"]
+    typed: set = set()
+    sample_lines: List[str] = []
+    for lineno, line in enumerate(lines, 1):
+        if line.startswith("#"):
+            if not _vt._PROM_COMMENT.match(line):
+                errors.append(
+                    f"{where}:{lineno}: malformed comment line: {line!r}"
+                )
+            elif line.startswith("# TYPE "):
+                typed.add(line.split()[2])
+            continue
+        if not _vt._PROM_SAMPLE.match(line):
+            errors.append(
+                f"{where}:{lineno}: malformed sample line: {line!r}"
+            )
+            continue
+        sample_lines.append(line)
+        name = _NAME.match(line).group(0)
+        if _family(name) not in typed:
+            errors.append(
+                f"{where}:{lineno}: sample {name!r} has no preceding "
+                "# TYPE comment (truncated scrape?)"
+            )
+    if not sample_lines:
+        errors.append(f"{where}: no metric samples")
+    # The shared label/triplet contracts — wire transport labels,
+    # ingest mode labels, serving outcome/reason labels, histogram
+    # sum/count completeness — straight from validate_trace.py.
+    errors.extend(_vt._check_wire_metrics(where, sample_lines))
+    return errors
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Schema-check a live /metrics scrape"
+    )
+    p.add_argument(
+        "source",
+        help="scrape file, or '-' to read the body from stdin",
+    )
+    args = p.parse_args(argv)
+    if args.source == "-":
+        text = sys.stdin.read()
+        where = "<stdin>"
+    else:
+        try:
+            with open(args.source) as f:
+                text = f.read()
+        except OSError as e:
+            print(f"{args.source}: unreadable: {e}", file=sys.stderr)
+            return 1
+        where = args.source
+    errors = validate_prom_text(text, where)
+    for err in errors:
+        print(err, file=sys.stderr)
+    print(f"{where}: {'OK' if not errors else f'{len(errors)} problem(s)'}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

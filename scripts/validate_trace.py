@@ -92,6 +92,16 @@ _PAIRHMM_SPANS = {
                         # count in args)
 }
 
+# Pod-exchange instant contract (parallel/podstream.py): every `pod.`
+# event must be one of these — merge_pod_trace.py's clock-offset
+# estimator keys on exactly this name and its
+# me/peer/step/stream/send_unix/recv_unix args, so a rename would
+# silently break pod trace merging.
+_POD_INSTANTS = {
+    "pod.exchange_ts",  # one peer's header round-trip timestamps
+                        # (send_unix/recv_unix) for one protocol step
+}
+
 # Prometheus exposition line shapes (text format 0.0.4).
 _PROM_COMMENT = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ?.*$")
 _PROM_SAMPLE = re.compile(
@@ -211,6 +221,14 @@ def validate_trace(path: str) -> List[str]:
                 f"{where}: unknown pairhmm span {ev['name']!r} "
                 f"(expected one of {sorted(_PAIRHMM_SPANS)})"
             )
+        elif (
+            ev["name"].startswith("pod.")
+            and ev["name"] not in _POD_INSTANTS
+        ):
+            errors.append(
+                f"{where}: unknown pod-exchange event {ev['name']!r} "
+                f"(expected one of {sorted(_POD_INSTANTS)})"
+            )
         if not isinstance(ev.get("pid"), int):
             errors.append(f"{where}: pid must be an int")
         if ph != "M":
@@ -265,7 +283,18 @@ _LABELED_COUNTERS = {
 # the full Prometheus triplet must be exposed, and GL003 requires a
 # live registration site for each name (a renamed emission can never
 # leave a dead schema entry).
-_SERVING_HISTOGRAMS = ("serving_gang_size",)
+_SERVING_HISTOGRAMS = (
+    "serving_gang_size",
+    "serving_queue_age_seconds",
+)
+
+# Serving-tier gauges: current-value series the /metrics and /statusz
+# surfaces expose; GL003 requires a live registration site for each
+# (same no-dead-schema-entry discipline as the histograms).
+_SERVING_GAUGES = (
+    "serving_inflight_jobs",
+    "serving_queue_depth",
+)
 
 
 def _check_wire_metrics(path: str, sample_lines: List[str]) -> List[str]:

@@ -520,9 +520,37 @@ def _cmd_serve_cohort(args) -> int:
             + (" (token auth)" if args.token else ""),
             flush=True,
         )
+    import contextlib
+    import os
+
     job_tier = None
+    stack = contextlib.ExitStack()
     try:
         if args.analyze:
+            # The live introspection plane (/metrics, /statusz,
+            # /jobs?trace=1) reads the ambient registry and tracer, so
+            # an analysis server keeps one collection session open for
+            # its whole lifetime — unless the CLI entrypoint already
+            # opened one for --trace-out/--metrics-out artifacts.
+            from spark_examples_tpu.obs.session import TelemetrySession
+            from spark_examples_tpu.obs.tracer import collection_active
+
+            if not collection_active():
+                stack.enter_context(
+                    TelemetrySession(command="serve-cohort")
+                )
+            if args.analyze_journal_dir:
+                # Crash flight recorder rides beside the journal: the
+                # last K span/metric transitions land in
+                # <journal>/flightrec/ on watchdog exit-77, SIGTERM,
+                # or an unhandled exception.
+                from spark_examples_tpu.obs import flightrec
+
+                flightrec.install(
+                    os.path.join(
+                        args.analyze_journal_dir, "flightrec"
+                    )
+                )
             job_tier = _analysis_tier(args, source)
             print(
                 f"Analysis tier up: queue depth "
@@ -569,6 +597,7 @@ def _cmd_serve_cohort(args) -> int:
             grpc_server.stop()
         if job_tier is not None:
             job_tier.close()
+        stack.close()
     return 0
 
 

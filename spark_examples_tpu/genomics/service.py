@@ -27,7 +27,11 @@ from __future__ import annotations
 
 import json
 import os
+import platform
+import socket
+import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterator, List, Optional
 from urllib.parse import parse_qs, urlencode, urlparse
@@ -113,7 +117,77 @@ def _decoded_lines(resp) -> Iterator[bytes]:
         yield buf
 
 
+def _compile_cache_status() -> Optional[dict]:
+    """The persistent XLA compile cache's directory + entry count (None
+    when jax was never imported or no cache dir is configured). Never
+    imports jax — host-only servers stay jax-free."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        directory = jax.config.jax_compilation_cache_dir
+    except Exception:  # pragma: no cover - config API drift
+        return None
+    if not directory:
+        return None
+    try:
+        entries = sum(
+            1
+            for name in os.listdir(directory)
+            if not name.startswith(".")
+        )
+    except OSError:
+        entries = 0
+    return {"dir": directory, "entries": entries}
+
+
+def _jit_retraces() -> Optional[int]:
+    """Process-wide jaxpr retrace count (None when the serving engine —
+    the module that installs the jax monitoring listener — was never
+    imported; a plain data server has nothing to retrace)."""
+    engine = sys.modules.get("spark_examples_tpu.serving.engine")
+    if engine is None:
+        return None
+    return int(engine.jit_retraces())
+
+
+def _build_fragment() -> dict:
+    """Git/build manifest for ``/statusz``: package version plus the
+    checkout's HEAD when serving from a git tree. Computed per request —
+    it's two stat-cheap reads and /statusz is not a hot path."""
+    doc: dict = {}
+    try:
+        from importlib import metadata
+
+        doc["version"] = metadata.version("spark-examples-tpu")
+    except Exception:
+        doc["version"] = None
+    # HEAD without shelling out: resolve .git/HEAD -> ref file. Absent
+    # (installed wheel, no checkout) is normal, not an error.
+    try:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        head_path = os.path.join(root, ".git", "HEAD")
+        with open(head_path, encoding="utf-8") as f:
+            head = f.read().strip()
+        if head.startswith("ref:"):
+            ref = head.split(None, 1)[1]
+            with open(
+                os.path.join(root, ".git", *ref.split("/")),
+                encoding="utf-8",
+            ) as f:
+                doc["git"] = f.read().strip()[:12]
+        else:
+            doc["git"] = head[:12]
+    except OSError:
+        doc["git"] = None
+    return doc
+
+
 def _make_handler(source, token: Optional[str], job_tier=None):
+    started_unix = time.time()
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -139,7 +213,7 @@ def _make_handler(source, token: Optional[str], job_tier=None):
             self.end_headers()
             self.wfile.write(body)
 
-        def _handle_jobs_get(self, path: str) -> None:
+        def _handle_jobs_get(self, path: str, q: dict) -> None:
             # The job tier's read surface: /jobs lists, /jobs/<id>
             # fetches one (result rows included when done). Records are
             # serialized UNDER the tier lock (job_records/job_record):
@@ -157,11 +231,92 @@ def _make_handler(source, token: Optional[str], job_tier=None):
                     },
                 )
                 return
-            rec = job_tier.job_record(path[len("/jobs/"):])
+            job_id = path[len("/jobs/"):]
+            rec = job_tier.job_record(job_id)
             if rec is None:
                 self.send_error(404, "no such job")
                 return
+            if q.get("trace") in ("1", "true"):
+                # The job's span timeline: every tracer event carrying
+                # the trace id minted at this job's admission (journal
+                # replay restores the id, so a resumed server serves
+                # the REPLAYED execution's timeline here).
+                rec["trace"] = job_tier.job_trace(job_id) or []
             self._send_json(200, rec)
+
+        # -- the live introspection plane ---------------------------------
+        #
+        # /metrics and /statusz sit behind the same bearer token as the
+        # data endpoints (queue shapes and tenant names are operator
+        # data). /healthz alone is served BEFORE auth: liveness probes
+        # come from load balancers that hold no tokens, and the reply
+        # carries only up/down bits.
+
+        def _handle_metrics(self) -> None:
+            # Prometheus text exposition straight off the ambient
+            # registry. Zero hot-path cost: exposition takes only the
+            # per-child metric locks, and collector-backed series
+            # (IoStats) are summed at scrape time, never per record.
+            from spark_examples_tpu import obs
+
+            body = obs.get_registry().to_prometheus().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _handle_healthz(self) -> None:
+            # Liveness + journal-writable + device-lock-not-wedged.
+            # Every probe is BOUNDED (the exit-77 discipline): a health
+            # check must never hang on the wedge it exists to detect.
+            checks: dict = {"live": True}
+            healthy = True
+            if job_tier is not None:
+                journal_ok = bool(job_tier.journal_writable())
+                checks["journal_writable"] = journal_ok
+                device_ok = bool(job_tier.device_available(0.5))
+                running = int(job_tier.running_jobs())
+                # Held WITH a running job = busy (healthy: the chip is
+                # doing the work it queued for). Held with nothing
+                # running = wedged.
+                wedged = (not device_ok) and running == 0
+                checks["device_lock"] = (
+                    "ok"
+                    if device_ok
+                    else ("busy" if running else "wedged")
+                )
+                healthy = journal_ok and not wedged
+            self._send_json(
+                200 if healthy else 503,
+                {
+                    "status": "ok" if healthy else "unhealthy",
+                    "checks": checks,
+                },
+            )
+
+        def _handle_statusz(self) -> None:
+            doc: dict = {
+                "server": {
+                    "started_unix": started_unix,
+                    "uptime_seconds": max(
+                        0.0, time.time() - started_unix
+                    ),
+                    "pid": os.getpid(),
+                    "host": socket.gethostname(),
+                    "python": platform.python_version(),
+                },
+                "build": _build_fragment(),
+                "tier": (
+                    job_tier.status() if job_tier is not None else None
+                ),
+                "compile_cache": _compile_cache_status(),
+                "jit_retraces": _jit_retraces(),
+            }
+            self._send_json(200, doc)
 
         def do_POST(self):  # noqa: N802 — http.server API
             # Drain the body FIRST, whatever the outcome: unread body
@@ -332,6 +487,11 @@ def _make_handler(source, token: Optional[str], job_tier=None):
             self.wfile.write(b"0\r\n\r\n")
 
         def do_GET(self):  # noqa: N802 — http.server API
+            # /healthz alone is pre-auth: load-balancer liveness probes
+            # hold no tokens, and the reply carries only up/down bits.
+            if self.path.split("?", 1)[0] == "/healthz":
+                self._handle_healthz()
+                return
             if not self._authorized():
                 self._deny()
                 return
@@ -508,10 +668,14 @@ def _make_handler(source, token: Optional[str], job_tier=None):
                                 break
                             self.wfile.write(chunk)
                             remaining -= len(chunk)
+                elif url.path == "/metrics":
+                    self._handle_metrics()
+                elif url.path == "/statusz":
+                    self._handle_statusz()
                 elif (
                     url.path == "/jobs" or url.path.startswith("/jobs/")
                 ) and job_tier is not None:
-                    self._handle_jobs_get(url.path)
+                    self._handle_jobs_get(url.path, q)
                 elif url.path.startswith("/export/"):
                     # Whole-cohort interchange-file export, framed and
                     # gzip-able like every stream: the bulk path remote

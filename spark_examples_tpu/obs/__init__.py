@@ -29,14 +29,17 @@ which are near-zero-cost no-ops when no session is active — the data
 plane pays nothing unless someone asked for telemetry.
 """
 
+from spark_examples_tpu.obs import flightrec
 from spark_examples_tpu.obs.tracer import (
     SpanTracer,
     collection_active,
     counter,
+    current_trace_id,
     get_tracer,
     instant,
     set_tracer,
     span,
+    trace_context,
 )
 from spark_examples_tpu.obs.metrics import (
     Counter,
@@ -61,10 +64,13 @@ __all__ = [
     "SpanTracer",
     "collection_active",
     "counter",
+    "current_trace_id",
+    "flightrec",
     "get_tracer",
     "set_tracer",
     "span",
     "instant",
+    "trace_context",
     "Counter",
     "Gauge",
     "Histogram",

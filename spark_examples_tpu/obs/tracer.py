@@ -34,25 +34,63 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import socket
 import sys
 import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
+from spark_examples_tpu.obs import flightrec
+
 __all__ = [
     "SpanTracer",
     "collection_active",
     "counter",
+    "current_trace_id",
     "get_tracer",
     "set_tracer",
     "span",
     "instant",
+    "trace_context",
 ]
 
 # Hard cap on buffered events: a runaway per-record span can otherwise
 # grow the trace without bound; past the cap events are counted, not
 # stored, and the drop count lands in the trace as a final instant.
 DEFAULT_MAX_EVENTS = 1_000_000
+
+
+# -- job-scoped trace context ------------------------------------------------
+#
+# A serving job's spans are ordinary spans (job.run, job.delta,
+# ingest.*, gramian.sparse.*) recorded into the shared event stream;
+# what makes them *the job's* timeline is a context FIELD, not a new
+# span set: the tier binds the job's trace_id to the worker thread for
+# the duration of execution, and every span/instant recorded inside
+# carries ``args.trace_id``. ``GET /jobs/<id>?trace=1`` then filters
+# the stream by that id.
+
+_trace_ctx = threading.local()
+
+
+def current_trace_id() -> Optional[str]:
+    """The trace id bound to THIS thread (None outside a job)."""
+    tid = getattr(_trace_ctx, "trace_id", None)
+    return tid if isinstance(tid, str) else None
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str]) -> Iterator[None]:
+    """Bind ``trace_id`` to the calling thread for the body's duration.
+
+    Nestable and restore-on-exit; ``None`` is a no-op binding so call
+    sites need no conditional."""
+    prev = getattr(_trace_ctx, "trace_id", None)
+    _trace_ctx.trace_id = trace_id if trace_id is not None else prev
+    try:
+        yield
+    finally:
+        _trace_ctx.trace_id = prev
 
 
 def _jax_annotation(name: str):
@@ -126,6 +164,7 @@ class SpanTracer:
         must be JSON-serializable (they land in the event's ``args``).
         """
         tid = threading.get_ident()
+        trace_id = current_trace_id()
         t_start = self._now_us()
         self._stack().append((name, t_start))
         annotation = _jax_annotation(name) if self._annotate_jax else None
@@ -146,6 +185,8 @@ class SpanTracer:
                 "pid": self._pid,
                 "tid": tid,
             }
+            if trace_id is not None:
+                args.setdefault("trace_id", trace_id)
             if args:
                 event["args"] = args
             with self._lock:
@@ -172,6 +213,9 @@ class SpanTracer:
             "tid": threading.get_ident(),
             "s": scope,
         }
+        trace_id = current_trace_id()
+        if trace_id is not None:
+            args.setdefault("trace_id", trace_id)
         if args:
             event["args"] = args
         self._append(event)
@@ -200,6 +244,20 @@ class SpanTracer:
         with self._lock:
             return dict(self._counts)
 
+    def events_for_trace(self, trace_id: str) -> List[Dict[str, Any]]:
+        """Time-ordered (by start) events carrying ``args.trace_id ==
+        trace_id`` — one serving job's span timeline pulled out of the
+        shared stream (``GET /jobs/<id>?trace=1``)."""
+        with self._lock:
+            events = [
+                dict(ev)
+                for ev in self._events
+                if isinstance(ev.get("args"), dict)
+                and ev["args"].get("trace_id") == trace_id
+            ]
+        events.sort(key=lambda ev: float(ev["ts"]))
+        return events
+
     def to_chrome(self) -> Dict[str, Any]:
         """The Chrome trace-event JSON object (Perfetto-loadable)."""
         meta = [
@@ -226,13 +284,26 @@ class SpanTracer:
                     "args": {"dropped": dropped},
                 }
             )
+        # Provenance for cross-process merging (scripts/merge_pod_trace
+        # .py): which host/OS-pid produced this file, and — when jax is
+        # already imported (pod runs) — which pod process index. Jax is
+        # never imported here; host-only traces simply omit the index.
+        other: Dict[str, Any] = {
+            "producer": self._process_name,
+            "trace_epoch_unix": self._epoch_unix,
+            "host": socket.gethostname(),
+            "pid": self._pid,
+        }
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                other["process_index"] = int(jax.process_index())
+            except Exception:  # pragma: no cover - backend unavailable
+                pass
         return {
             "traceEvents": meta + events,
             "displayTimeUnit": "ms",
-            "otherData": {
-                "producer": self._process_name,
-                "trace_epoch_unix": self._epoch_unix,
-            },
+            "otherData": other,
         }
 
     def write(self, path: str) -> None:
@@ -281,16 +352,26 @@ def collection_active() -> bool:
 
 @contextlib.contextmanager
 def span(name: str, **args: Any) -> Iterator[None]:
-    """Ambient span: records into the session tracer, no-op otherwise."""
-    if not _active:
-        yield
-        return
-    with get_tracer().span(name, **args):
-        yield
+    """Ambient span: records into the session tracer, no-op otherwise.
+
+    The flight recorder (when installed) sees the begin/end transitions
+    regardless of whether a session is active — that is its whole point:
+    a last-seconds record even with full tracing off."""
+    flightrec.note("span_begin", name, args or None)
+    try:
+        if not _active:
+            yield
+        else:
+            with get_tracer().span(name, **args):
+                yield
+    finally:
+        flightrec.note("span_end", name, None)
 
 
 def instant(name: str, scope: str = "t", **args: Any) -> None:
-    """Ambient instant event: no-op unless a session is active."""
+    """Ambient instant event: no-op unless a session is active (the
+    flight recorder, when installed, always sees it)."""
+    flightrec.note("instant", name, args or None)
     if _active:
         get_tracer().instant(name, scope=scope, **args)
 
@@ -298,6 +379,8 @@ def instant(name: str, scope: str = "t", **args: Any) -> None:
 def counter(name: str, **series: float) -> None:
     """Ambient counter ("C") sample — a stacked-area track in the
     viewer (queue depth, in-flight jobs). No-op unless a session is
-    active, like every ambient helper."""
+    active, like every ambient helper (the flight recorder, when
+    installed, records the delta)."""
+    flightrec.note("counter", name, series or None)
     if _active:
         get_tracer().counter(name, **series)

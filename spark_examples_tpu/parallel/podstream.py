@@ -56,6 +56,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -391,6 +392,11 @@ class PodWindowExchange:
         self._own_header = np.zeros(0, np.int64)
         self._own_confirm = np.int64(0)
         self._own_check = np.int64(0)
+        # Wall-clock of this step's own header post: paired with each
+        # peer header's arrival time in gather_headers to mint the
+        # pod.exchange_ts instants merge_pod_trace.py estimates
+        # per-peer clock offsets from (NTP midpoint method).
+        self._last_send_unix = 0.0
 
     @property
     def stream(self) -> int:
@@ -423,18 +429,39 @@ class PodWindowExchange:
 
     def post_header(self, step: int, fields: np.ndarray) -> None:
         self._own_header = np.asarray(fields, np.int64)
+        self._last_send_unix = time.time()
         self._post_all(step, _KIND_HEADER, self._own_header.tobytes())
 
     def gather_headers(self, step: int, n_fields: int) -> np.ndarray:
         """(world, n_fields) int64 — every process's step header (own
         row included, like the allgather it replaces)."""
         rows: List[Optional[np.ndarray]] = [None] * self._world
+        recv_unix: Dict[int, float] = {}
         for p in range(self._world):
             if p == self._pid:
                 continue
             rows[p] = np.frombuffer(
                 self._mesh.recv(p, self._stream, step, _KIND_HEADER),
                 dtype=np.int64,
+            )
+            recv_unix[p] = time.time()
+        # One instant per peer AFTER the loop — the recv path itself
+        # stays untouched. send_unix is when WE posted this step's
+        # header, recv_unix when the peer's arrived: the (send, recv)
+        # pair this process contributes to the midpoint offset estimate
+        # (the peer's mirror-image instant completes the round trip).
+        from spark_examples_tpu import obs
+
+        for p, rts in recv_unix.items():
+            obs.instant(
+                "pod.exchange_ts",
+                scope="t",
+                me=self._pid,
+                peer=p,
+                step=step,
+                stream=self._stream,
+                send_unix=self._last_send_unix,
+                recv_unix=rts,
             )
         return np.stack(
             [

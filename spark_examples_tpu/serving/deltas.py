@@ -411,6 +411,19 @@ class DeltaIndex:
         with self._lock:
             return len(self._entries)
 
+    def stats(self) -> Dict[str, int]:
+        """Occupancy snapshot for ``/statusz`` (hit-ratio lives in the
+        metrics registry: ``serving_delta_jobs_total{outcome=...}``)."""
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": int(self._bytes),
+                "max_bytes": int(self.max_bytes),
+                "window_sets": len(self._windows),
+                "window_bytes": int(sum(self._window_bytes.values())),
+                "max_window_bytes": int(self.max_window_bytes),
+            }
+
     # -- full-frame window cache ----------------------------------------------
 
     def windows(

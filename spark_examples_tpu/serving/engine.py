@@ -29,7 +29,7 @@ import collections
 import dataclasses
 import sys
 import threading
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -39,7 +39,55 @@ from spark_examples_tpu.serving.deltas import (
     note_delta,
 )
 
-__all__ = ["AnalysisEngine"]
+__all__ = ["AnalysisEngine", "jit_retraces"]
+
+# -- jit retrace accounting ---------------------------------------------------
+#
+# A serving tier whose specs vary geometry can silently retrace/recompile
+# per job — the regression /statusz must surface. jax.monitoring emits
+# one "/jax/core/compile/jaxpr_trace_duration" duration event per trace;
+# counting them is the process-wide retrace count. Registered lazily
+# (first engine construction) and only when jax is importable; the
+# listener API is additive, so this never perturbs execution.
+
+_RETRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_retrace_lock = threading.Lock()
+_retrace_count = 0
+_retrace_listener_installed = False
+
+
+def jit_retraces() -> int:
+    """Process-wide count of jaxpr traces observed so far (0 until the
+    listener is installed by the first engine)."""
+    with _retrace_lock:
+        return _retrace_count
+
+
+def _on_jax_duration_event(
+    event: str, duration_secs: float, **_kw: Any
+) -> None:
+    global _retrace_count
+    if event == _RETRACE_EVENT:
+        with _retrace_lock:
+            _retrace_count += 1
+
+
+def _install_retrace_listener() -> None:
+    global _retrace_listener_installed
+    with _retrace_lock:
+        if _retrace_listener_installed:
+            return
+        _retrace_listener_installed = True
+    try:
+        from jax import monitoring
+    except Exception:  # pragma: no cover - jax absent
+        return
+    try:
+        monitoring.register_event_duration_secs_listener(
+            _on_jax_duration_event
+        )
+    except Exception:  # pragma: no cover - listener API unavailable
+        pass
 
 # Distinct variantset tuples whose CallsetIndex stays resident. Bounded
 # because the tuple is CLIENT-SUPPLIED on a multi-tenant surface: an
@@ -71,6 +119,7 @@ class AnalysisEngine:
     ) -> None:
         self.source = source
         self.mesh = mesh
+        _install_retrace_listener()
         # One chip owner at a time — see the module docstring.
         self._device_lock = threading.Lock()
         self._index_lock = threading.Lock()
@@ -118,6 +167,25 @@ class AnalysisEngine:
             mesh=self.mesh,
             index=self.index_for(tuple(conf.variant_set_ids)),
         )
+
+    # -- introspection (the /healthz and /statusz sources) --------------------
+
+    def device_lock_available(self, timeout_s: float = 0.5) -> bool:
+        """Probe the device lock with a BOUNDED wait (the exit-77
+        discipline: a health probe must never hang on the very wedge it
+        exists to detect). False means "held for longer than the
+        probe's patience" — the caller disambiguates busy-with-work
+        from wedged via the tier's running-job count."""
+        if not self._device_lock.acquire(timeout=max(0.0, timeout_s)):
+            return False
+        try:
+            return True
+        finally:
+            self._device_lock.release()
+
+    def delta_stats(self) -> Optional[Dict[str, int]]:
+        """Delta-cache occupancy (None when the tier is unarmed)."""
+        return self._deltas.stats() if self._deltas is not None else None
 
     # -- gang/delta compatibility probes (host-side, no device work) ----------
 

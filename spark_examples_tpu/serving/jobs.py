@@ -399,6 +399,10 @@ class Job:
     # (name, loglik, bucket) for pairhmm.
     result: Optional[List[Tuple[Any, ...]]] = None
     submitted_unix: float = field(default_factory=time.time)
+    # Minted at admission, carried through journal -> replay -> every
+    # span the job's execution emits (a tracer context field). None on
+    # synthetic cache-hit views (no execution, no timeline).
+    trace_id: Optional[str] = None
 
     def to_record(self, include_result: bool = True) -> Dict[str, Any]:
         rec: Dict[str, Any] = {
@@ -409,6 +413,8 @@ class Job:
             "submitted_unix": self.submitted_unix,
             "spec": self.spec.to_record(),
         }
+        if self.trace_id is not None:
+            rec["trace_id"] = self.trace_id
         if self.error is not None:
             rec["error"] = self.error
         if include_result and self.result is not None:
@@ -493,6 +499,23 @@ class JobJournal:
                 return
             self._f.flush()
             os.fsync(self._f.fileno())
+        finally:
+            self._lock.release()
+
+    def probe(self, timeout_s: float = 0.5) -> bool:
+        """Bounded writability probe (the ``/healthz`` journal check):
+        True when the journal file is open and flushable. Same bounded-
+        wait discipline as :meth:`flush` — a probe that hangs on the
+        wedged writer it exists to detect is worse than useless."""
+        if not self._lock.acquire(timeout=max(0.0, timeout_s)):
+            return False
+        try:
+            if self._f.closed:
+                return False
+            self._f.flush()
+            return True
+        except OSError:
+            return False
         finally:
             self._lock.release()
 

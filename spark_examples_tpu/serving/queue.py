@@ -125,6 +125,20 @@ class AdmissionQueue:
             # Also a trace counter track: depth-over-time next to the
             # job.run spans is how a shed burst reads on the timeline.
             obs.counter("serving_queue_depth", depth=depth)
+            self._note_inflight_locked()
+
+    def _note_inflight_locked(self) -> None:
+        assert_lock_held(self._cv, "AdmissionQueue._note_inflight_locked")
+        from spark_examples_tpu import obs
+        from spark_examples_tpu.obs.tracer import collection_active
+
+        if collection_active():
+            inflight = float(sum(self._in_flight.values()))
+            obs.get_registry().gauge(
+                "serving_inflight_jobs",
+                "Admitted analysis jobs not yet terminal "
+                "(queued + running, all tenants)",
+            ).set(inflight)
 
     # -- admission ------------------------------------------------------------
 
@@ -258,6 +272,7 @@ class AdmissionQueue:
         terminal state (done/failed), never at dequeue."""
         with self._cv:
             self._release_tenant_locked(tenant)
+            self._note_inflight_locked()
 
     def depth(self) -> int:
         with self._cv:
@@ -266,3 +281,8 @@ class AdmissionQueue:
     def in_flight(self, tenant: str) -> int:
         with self._cv:
             return self._in_flight.get(tenant, 0)
+
+    def in_flight_by_tenant(self) -> Dict[str, int]:
+        """Snapshot of every tenant's in-flight count (``/statusz``)."""
+        with self._cv:
+            return dict(self._in_flight)

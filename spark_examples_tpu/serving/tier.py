@@ -38,6 +38,8 @@ import collections
 import shutil
 import sys
 import threading
+import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from spark_examples_tpu.serving.jobs import (
@@ -168,6 +170,7 @@ class AnalysisJobTier:
         self._stop = threading.Event()
         self._workers: List[threading.Thread] = []
         self._n_workers = max(0, workers)
+        self._started_unix = time.time()
         if self._journal is not None:
             self._replay()
 
@@ -237,6 +240,7 @@ class AnalysisJobTier:
                         id=active.id, spec=spec, key=key,
                         seq=active.seq, state=active.state,
                         error=active.error, result=active.result,
+                        trace_id=active.trace_id,
                     ),
                     False,
                 )
@@ -247,8 +251,12 @@ class AnalysisJobTier:
             self._breaker.before_call()  # raises CircuitOpenError
             self._seq += 1
             seq = self._seq
+            # The trace id is MINTED at admission — not derived from
+            # the spec or cohort key (those are shared across tenants
+            # and resubmissions; the timeline is this submission's).
             job = Job(
-                id=f"j-{key[:12]}-{seq}", spec=spec, key=key, seq=seq
+                id=f"j-{key[:12]}-{seq}", spec=spec, key=key, seq=seq,
+                trace_id=uuid.uuid4().hex[:16],
             )
             try:
                 self._queue.admit(job, spec.tenant, spec.priority, seq)
@@ -274,6 +282,7 @@ class AnalysisJobTier:
                         "key": key,
                         "spec": spec.to_record(),
                         "ts": job.submitted_unix,
+                        "trace": job.trace_id,
                     }
                 )
             except Exception as e:  # noqa: BLE001 — disk weather
@@ -363,6 +372,81 @@ class AnalysisJobTier:
     def queue_depth(self) -> int:
         return self._queue.depth()
 
+    # -- introspection (the /statusz and /jobs?trace=1 sources) ---------------
+
+    def status(self) -> Dict[str, Any]:
+        """One introspection snapshot (``GET /statusz``): queue and
+        tenant pressure, breaker state, job table shape, caches. Lock
+        order is tier → queue, same as every worker path."""
+        with self._lock:
+            by_state: Dict[str, int] = {}
+            kinds: Dict[str, int] = {}
+            for j in self._jobs.values():
+                by_state[j.state] = by_state.get(j.state, 0) + 1
+                kinds[j.spec.kind] = kinds.get(j.spec.kind, 0) + 1
+            doc: Dict[str, Any] = {
+                "uptime_seconds": max(
+                    0.0, time.time() - self._started_unix
+                ),
+                "jobs_by_state": by_state,
+                "resident_job_kinds": kinds,
+                "result_cache_entries": len(self._cache),
+                "journal_dir": self._journal_dir,
+                "workers": self._n_workers,
+                "gang_max_samples": self._gang_max,
+            }
+        doc["queue_depth"] = self._queue.depth()
+        doc["in_flight_by_tenant"] = self._queue.in_flight_by_tenant()
+        doc["breakers"] = {"analyze": self._breaker.state}
+        delta_stats = getattr(self._engine, "delta_stats", None)
+        doc["delta_cache"] = delta_stats() if delta_stats else None
+        return doc
+
+    def running_jobs(self) -> int:
+        """Jobs currently in the RUNNING state (the /healthz busy-vs-
+        wedged disambiguator)."""
+        with self._lock:
+            return sum(
+                1
+                for j in self._jobs.values()
+                if j.state == JOB_RUNNING
+            )
+
+    def journal_writable(self) -> bool:
+        """Bounded journal writability (``/healthz``). A journal-less
+        tier is vacuously writable — there is nothing to wedge."""
+        if self._journal is None:
+            return True
+        try:
+            return self._journal.probe()
+        except Exception:  # noqa: BLE001 — health checks never raise
+            return False
+
+    def device_available(self, timeout_s: float = 0.5) -> bool:
+        """Bounded device-lock probe (``/healthz``): False when the
+        engine's dispatch lock cannot be taken within ``timeout_s``.
+        Pair with :meth:`running_jobs` to tell busy from wedged."""
+        probe = getattr(self._engine, "device_lock_available", None)
+        if probe is None:
+            return True
+        return bool(probe(timeout_s))
+
+    def job_trace(self, job_id: str) -> Optional[List[Dict[str, Any]]]:
+        """The job's span timeline (``GET /jobs/<id>?trace=1``): every
+        event in the ambient tracer carrying its trace id. None =
+        unknown job; [] = known but nothing recorded (yet, or no
+        telemetry session active)."""
+        from spark_examples_tpu import obs
+
+        with self._lock:
+            job = self._jobs.get(job_id)
+            trace_id = job.trace_id if job is not None else None
+        if job is None:
+            return None
+        if trace_id is None:
+            return []
+        return obs.get_tracer().events_for_trace(trace_id)
+
     # -- execution ------------------------------------------------------------
 
     def step(self, timeout: float = 0.0) -> bool:
@@ -446,6 +530,20 @@ class AnalysisJobTier:
             buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0),
         ).observe(float(size))
 
+    def _note_queue_age(self, job: Job) -> None:
+        """Admission→start latency, per job kind — the queueing SLO
+        series gang tuning and /statusz watch. Observed at the
+        QUEUED→RUNNING transition; a replayed job's age spans the
+        crash, which is exactly the latency its submitter saw."""
+        from spark_examples_tpu import obs
+
+        obs.get_registry().histogram(
+            "serving_queue_age_seconds",
+            "Admission-to-start latency of analysis jobs by kind",
+        ).labels(kind=job.spec.kind).observe(
+            max(0.0, time.time() - job.submitted_unix)
+        )
+
     def _execute_gang(self, jobs: List[Job]) -> None:
         """Run a coalesced gang: per-job journal transitions exactly as
         solo execution writes them (crash-safe replay semantics are
@@ -465,9 +563,12 @@ class AnalysisJobTier:
         # Disk I/O outside the tier lock (submit() reasoning).
         for job in live:
             self._journal_append_safe({"e": "start", "id": job.id})
-            obs.instant(
-                "job_transition", scope="p", id=job.id, to=JOB_RUNNING
-            )
+            self._note_queue_age(job)
+            with obs.trace_context(job.trace_id):
+                obs.instant(
+                    "job_transition", scope="p", id=job.id,
+                    to=JOB_RUNNING,
+                )
         for job in live:
             try:
                 faults.inject("serving.job.kill", key=job.id)
@@ -492,7 +593,10 @@ class AnalysisJobTier:
             return
         self._note_gang(len(runnable))
         try:
-            with obs.span(
+            # One batched dispatch can only carry one thread context:
+            # the gang span binds the LEAD's trace id; members are
+            # recoverable from the span's job-id list.
+            with obs.trace_context(runnable[0].trace_id), obs.span(
                 "job.gang",
                 size=len(runnable),
                 jobs=",".join(j.id for j in runnable),
@@ -600,28 +704,37 @@ class AnalysisJobTier:
             job.state = JOB_RUNNING
         # Disk I/O outside the tier lock (submit() reasoning).
         self._journal_append_safe({"e": "start", "id": job.id})
-        obs.instant(
-            "job_transition", scope="p", id=job.id, to=JOB_RUNNING
-        )
+        self._note_queue_age(job)
+        ckpt: Optional[str] = None
         try:
-            faults.inject("serving.job.kill", key=job.id)
-        except faults.InjectedFault as e:
-            # Leave the journal exactly as a SIGKILL here would: start
-            # recorded, no terminal event — and kill this worker.
-            raise SimulatedCrash(str(e)) from e
-        ckpt = self._ckpt_dir(job)
-        try:
-            with obs.span(
-                "job.run",
-                job_id=job.id,
-                tenant=job.spec.tenant,
-                kind=job.spec.kind,
-            ):
-                faults.inject("serving.job.run", key=job.id)
-                rows = self._engine.run(
-                    job_config(job.spec, self._base, checkpoint_dir=ckpt),
-                    kind=job.spec.kind,
+            with obs.trace_context(job.trace_id):
+                obs.instant(
+                    "job_transition", scope="p", id=job.id,
+                    to=JOB_RUNNING,
                 )
+                try:
+                    faults.inject("serving.job.kill", key=job.id)
+                except faults.InjectedFault as e:
+                    # Leave the journal exactly as a SIGKILL here
+                    # would: start recorded, no terminal event — and
+                    # kill this worker.
+                    raise SimulatedCrash(str(e)) from e
+                ckpt = self._ckpt_dir(job)
+                with obs.span(
+                    "job.run",
+                    job_id=job.id,
+                    tenant=job.spec.tenant,
+                    kind=job.spec.kind,
+                ):
+                    faults.inject("serving.job.run", key=job.id)
+                    rows = self._engine.run(
+                        job_config(
+                            job.spec, self._base, checkpoint_dir=ckpt
+                        ),
+                        kind=job.spec.kind,
+                    )
+        except SimulatedCrash:
+            raise
         except Exception as e:  # noqa: BLE001 — job isolation boundary
             self._finish(job, error=f"{type(e).__name__}: {e}")
             # IO-shaped failures (dead upstream source, injected
@@ -738,6 +851,12 @@ class AnalysisJobTier:
                         key=str(e.get("key") or cohort_key(spec, self._base)),
                         seq=seq,
                         submitted_unix=float(e.get("ts", 0.0)),
+                        # Restore the admission-minted trace id so the
+                        # replayed execution re-emits ITS timeline
+                        # (same span names/order; durations differ).
+                        trace_id=(
+                            str(e["trace"]) if e.get("trace") else None
+                        ),
                     )
                     self._jobs[job.id] = job
                     self._seq = max(self._seq, seq)

@@ -607,3 +607,321 @@ class TestIngestSpanEmission:
             if k.startswith("ingest_block_build_seconds")
         }
         assert hists and any(v["count"] >= 1 for v in hists.values())
+
+
+class TestTraceContext:
+    """PR 16 job-scoped tracing: a trace id is a CONTEXT FIELD stamped
+    onto every span/instant emitted under it — not a new span set."""
+
+    def test_default_is_unbound(self):
+        assert obs.current_trace_id() is None
+
+    def test_binding_restores_and_none_inherits(self):
+        with obs.trace_context("aaaa"):
+            assert obs.current_trace_id() == "aaaa"
+            # None = "keep whatever is bound": call sites never need a
+            # conditional around the context manager.
+            with obs.trace_context(None):
+                assert obs.current_trace_id() == "aaaa"
+            with obs.trace_context("bbbb"):
+                assert obs.current_trace_id() == "bbbb"
+            assert obs.current_trace_id() == "aaaa"
+        assert obs.current_trace_id() is None
+
+    def test_binding_is_thread_local(self):
+        seen = []
+
+        def other():
+            seen.append(obs.current_trace_id())
+
+        with obs.trace_context("aaaa"):
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+        assert seen == [None]
+
+    def test_spans_and_instants_carry_the_id_counters_do_not(self):
+        with TelemetrySession() as session:
+            with obs.trace_context("tid1"):
+                with obs.span("fused_finish", n=1):
+                    pass
+                obs.instant("job_transition", scope="p", to="running")
+                obs.counter("serving_queue_depth", depth=3.0)
+            with obs.span("fused_finish", n=2):
+                pass
+            events = session.tracer.to_chrome()["traceEvents"]
+        tagged = [
+            ev
+            for ev in events
+            if isinstance(ev.get("args"), dict)
+            and ev["args"].get("trace_id") == "tid1"
+        ]
+        assert {ev["ph"] for ev in tagged} == {"X", "i"}
+        # Counter tracks must stay numeric-only (stacked-area
+        # rendering) — never stamped.
+        counters = [ev for ev in events if ev["ph"] == "C"]
+        assert counters and all(
+            "trace_id" not in ev["args"] for ev in counters
+        )
+        # The second span ran outside the context: untagged.
+        untagged = [
+            ev
+            for ev in events
+            if ev["ph"] == "X" and ev["args"].get("n") == 2
+        ]
+        assert untagged and "trace_id" not in untagged[0]["args"]
+
+    def test_events_for_trace_filters_and_orders(self):
+        with TelemetrySession() as session:
+            with obs.trace_context("tidA"):
+                with obs.span("fused_finish", leg=1):
+                    pass
+            with obs.trace_context("tidB"):
+                with obs.span("fused_finish", leg=2):
+                    pass
+            with obs.trace_context("tidA"):
+                obs.instant("job_transition", scope="p", to="done")
+            evs = session.tracer.events_for_trace("tidA")
+            assert [e["args"].get("leg", None) for e in evs] == [1, None]
+            tss = [float(e["ts"]) for e in evs]
+            assert tss == sorted(tss)
+            assert session.tracer.events_for_trace("nope") == []
+
+    def test_trace_carries_process_provenance(self):
+        import socket as socket_mod
+
+        with TelemetrySession() as session:
+            with obs.span("fused_finish"):
+                pass
+            other = session.tracer.to_chrome()["otherData"]
+        assert other["host"] == socket_mod.gethostname()
+        assert other["pid"] == os.getpid()
+        assert isinstance(other["trace_epoch_unix"], float)
+
+
+class TestFlightRecorder:
+    """The crash black box: per-thread overwrite rings, reasoned JSONL
+    dumps, hook/handler chaining — always on once installed, cheap
+    enough for production (one global read when off)."""
+
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        from spark_examples_tpu.obs import flightrec
+
+        flightrec.uninstall()
+        yield
+        flightrec.uninstall()
+
+    def test_ring_overwrites_keeping_the_last_k(self):
+        from spark_examples_tpu.obs import flightrec
+
+        rec = flightrec.FlightRecorder(capacity_per_thread=8)
+        for i in range(30):
+            rec.note("instant", f"ev{i}", {"i": i})
+        snap = rec.snapshot()
+        assert len(snap) == 8
+        # Exactly the last 8 survive; snapshot order is by timestamp,
+        # which can tie at clock resolution — compare as a set.
+        assert sorted(r["fields"]["i"] for r in snap) == list(range(22, 30))
+
+    def test_threads_write_locklessly_and_merge_sorted(self):
+        from spark_examples_tpu.obs import flightrec
+
+        rec = flightrec.FlightRecorder(capacity_per_thread=64)
+
+        def work(tag):
+            for i in range(50):
+                rec.note("metric", tag, {"i": i})
+
+        threads = [
+            threading.Thread(target=work, args=(f"t{k}",), name=f"w{k}")
+            for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = rec.snapshot()
+        assert len(snap) == 200
+        assert {r["thread"] for r in snap} == {f"w{k}" for k in range(4)}
+        tss = [r["ts_unix"] for r in snap]
+        assert tss == sorted(tss)
+
+    def test_dump_schema_and_atomicity(self, tmp_path):
+        from spark_examples_tpu.obs import flightrec
+
+        rec = flightrec.FlightRecorder()
+        rec.note("span_begin", "job.run", {"job_id": "j-1"})
+        rec.note("metric", "serving_jobs_total", {"delta": 1.0})
+        rec.note("instant", "bad", {"obj": object()})  # unserializable
+        path = str(tmp_path / "d" / "flightrec-test.jsonl")
+        rec.dump(path, "test")
+        lines = [json.loads(l) for l in open(path)]
+        header, records = lines[0], lines[1:]
+        assert header["schema"] == "spark_examples_tpu.flightrec/v1"
+        assert header["reason"] == "test"
+        assert header["pid"] == os.getpid()
+        assert [r["name"] for r in records] == [
+            "job.run",
+            "serving_jobs_total",
+            "bad",
+        ]
+        assert records[2]["unserializable_fields"] is True
+        assert not os.path.exists(path + ".tmp")  # tmp+rename, no ruins
+
+    def test_ambient_helpers_tap_the_recorder_without_a_session(
+        self, tmp_path
+    ):
+        """The black box works with tracing OFF — that is its reason to
+        exist: span/instant transitions and metric deltas land in the
+        rings even when no telemetry session is active."""
+        from spark_examples_tpu.obs import flightrec
+
+        assert not obs.collection_active()
+        flightrec.install(str(tmp_path), handle_signals=False)
+        with obs.span("job.run", job_id="j-9"):
+            obs.instant("job_transition", scope="p", to="running")
+        reg = obs.get_registry()
+        reg.counter("serving_jobs_total").labels(outcome="done").inc()
+        snap = flightrec.get_recorder().snapshot()
+        kinds = {(r["kind"], r["name"]) for r in snap}
+        assert ("span_begin", "job.run") in kinds
+        assert ("span_end", "job.run") in kinds
+        assert ("instant", "job_transition") in kinds
+        assert ("metric", "serving_jobs_total") in kinds
+        path = flightrec.dump_now("watchdog")
+        assert path and path.endswith("flightrec-watchdog.jsonl")
+        assert os.path.exists(path)
+
+    def test_install_is_idempotent_and_uninstall_restores(self, tmp_path):
+        import sys
+
+        from spark_examples_tpu.obs import flightrec
+
+        prev_hook = sys.excepthook
+        rec1 = flightrec.install(str(tmp_path), handle_signals=False)
+        rec2 = flightrec.install(str(tmp_path / "other"), handle_signals=False)
+        assert rec1 is rec2
+        assert sys.excepthook is not prev_hook
+        flightrec.uninstall()
+        assert sys.excepthook is prev_hook
+        assert flightrec.get_recorder() is None
+        flightrec.note("instant", "after", None)  # no-op, no crash
+
+    def test_excepthook_dumps_then_chains(self, tmp_path, capsys):
+        import sys
+
+        from spark_examples_tpu.obs import flightrec
+
+        seen = []
+        prev_hook = sys.excepthook
+        sys.excepthook = lambda *a: seen.append(a)
+        try:
+            flightrec.install(str(tmp_path), handle_signals=False)
+            flightrec.note("instant", "before_crash", None)
+            try:
+                raise RuntimeError("boom")
+            except RuntimeError:
+                sys.excepthook(*sys.exc_info())
+            dump = os.path.join(str(tmp_path), "flightrec-exception.jsonl")
+            assert os.path.exists(dump)
+            lines = [json.loads(l) for l in open(dump)]
+            assert lines[0]["reason"] == "exception"
+            names = [r["name"] for r in lines[1:]]
+            assert "before_crash" in names
+            assert "unhandled_exception" in names
+            assert len(seen) == 1  # the previous hook still ran
+        finally:
+            flightrec.uninstall()
+            sys.excepthook = prev_hook
+
+    def test_periodic_flusher_writes_last_snapshot(self, tmp_path):
+        import time as time_mod
+
+        from spark_examples_tpu.obs import flightrec
+
+        flightrec.install(
+            str(tmp_path), flush_interval_s=0.05, handle_signals=False
+        )
+        flightrec.note("instant", "tick", None)
+        last = os.path.join(str(tmp_path), "flightrec-last.jsonl")
+        deadline = time_mod.time() + 5
+        while time_mod.time() < deadline and not os.path.exists(last):
+            time_mod.sleep(0.02)
+        assert os.path.exists(last), "periodic flusher never wrote"
+        lines = [json.loads(l) for l in open(last)]
+        assert lines[0]["reason"] == "periodic"
+
+
+class TestScrapeWhileWriting:
+    """PR 16 satellite: a /metrics scrape (to_prometheus) racing hot
+    writers must neither tear a histogram triplet, block the writers,
+    nor double-count — pinned with the lock-check backstop armed."""
+
+    @pytest.fixture(autouse=True)
+    def _lock_check(self, monkeypatch):
+        monkeypatch.setenv("SPARK_EXAMPLES_TPU_LOCK_CHECK", "1")
+        yield
+
+    def test_concurrent_scrape_is_consistent(self):
+        reg = MetricsRegistry()
+        n_threads, per_thread = 4, 3000
+        stop = threading.Event()
+        scrapes = []
+        errors = []
+
+        def writer(k):
+            c = reg.counter("scrape_race_total", "writes")
+            g = reg.gauge("scrape_race_inflight", "now")
+            h = reg.histogram(
+                "scrape_race_seconds", "lat", buckets=(0.1, 1.0)
+            )
+            try:
+                for i in range(per_thread):
+                    c.labels(worker=str(k)).inc()
+                    g.set(float(i))
+                    h.observe(0.05 if i % 2 else 5.0)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        def scraper():
+            try:
+                while not stop.is_set():
+                    scrapes.append(reg.to_prometheus())
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=writer, args=(k,))
+            for k in range(n_threads)
+        ]
+        s = threading.Thread(target=scraper)
+        s.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        s.join(timeout=10)
+        assert not s.is_alive() and not errors, errors
+        assert scrapes, "scraper never completed a pass"
+        # No double-count / no lost writes: the final exposition sums
+        # to exactly what the writers wrote.
+        final = reg.to_prometheus()
+        import re as re_mod
+
+        totals = [
+            float(m.group(1))
+            for m in re_mod.finditer(
+                r'scrape_race_total\{worker="\d+"\} ([0-9.e+]+)', final
+            )
+        ]
+        assert sum(totals) == n_threads * per_thread
+        assert f"scrape_race_seconds_count {n_threads * per_thread}" in final
+        # No torn triplet in ANY mid-run scrape: bucket lines never
+        # appear without their sum/count (the schema checker's rule,
+        # applied to every racing exposition).
+        for text in scrapes[-5:]:
+            if "scrape_race_seconds_bucket" in text:
+                assert "scrape_race_seconds_sum" in text
+                assert "scrape_race_seconds_count" in text

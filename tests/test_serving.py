@@ -1204,6 +1204,337 @@ class TestDeltaGangSchemaDrift:
         assert errs and any("_sum" in e for e in errs)
 
 
+def _load_promtext():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "validate_promtext",
+        os.path.join(_REPO_ROOT, "scripts", "validate_promtext.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _get_raw(conn, path, headers=None):
+    conn.request("GET", path, headers=headers or {})
+    resp = conn.getresponse()
+    return resp.status, dict(resp.getheaders()), resp.read()
+
+
+class TestIntrospectionPlane:
+    """PR 16: the live introspection endpoints — /metrics (Prometheus
+    text off the ambient registry), /healthz (bounded liveness probes,
+    served pre-auth), /statusz (one JSON operational snapshot), and
+    /jobs/<id>?trace=1 (the job-scoped span timeline)."""
+
+    @pytest.fixture()
+    def live(self, served_source):
+        src, base, _ = served_source
+        with TelemetrySession():
+            tier = AnalysisJobTier(
+                AnalysisEngine(src), base, workers=1
+            ).start()
+            server = GenomicsServiceServer(src, job_tier=tier).start()
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=30
+            )
+            try:
+                yield src, base, tier, server, conn
+            finally:
+                conn.close()
+                server.stop()
+                tier.close()
+
+    def _run_job(self, conn, spec=None):
+        st, _, doc = _post(conn, "/analyze", spec or {"num_pc": 2})
+        assert st in (200, 202), doc
+        jid = doc["id"]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            st, jd = _get(conn, f"/jobs/{jid}")
+            if jd["state"] in ("done", "failed"):
+                assert jd["state"] == "done", jd
+                return jid
+            time.sleep(0.05)
+        raise TimeoutError(f"job {jid} never finished")
+
+    def test_healthz_is_served_before_auth(self, served_source):
+        """Liveness probes come from load balancers holding no tokens:
+        /healthz answers unauthenticated on a token-configured server,
+        while /metrics and /statusz stay behind the bearer check."""
+        src, base, _ = served_source
+        tier = AnalysisJobTier(AnalysisEngine(src), base, workers=0)
+        server = GenomicsServiceServer(
+            src, token="sekrit", job_tier=tier
+        ).start()
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", server.port, timeout=30
+        )
+        try:
+            st, _, body = _get_raw(conn, "/healthz")
+            assert st == 200
+            doc = json.loads(body)
+            assert doc["status"] == "ok" and doc["checks"]["live"]
+            for path in ("/metrics", "/statusz"):
+                st, _, _b = _get_raw(conn, path)
+                assert st == 401, f"{path} served without a token"
+            st, _, _b = _get_raw(
+                conn,
+                "/statusz",
+                headers={"Authorization": "Bearer sekrit"},
+            )
+            assert st == 200
+        finally:
+            conn.close()
+            server.stop()
+            tier.close()
+
+    def test_healthz_disambiguates_busy_from_wedged(self, served_source):
+        """Device lock held with NO running job = wedged (503); held
+        WITH one = busy doing the work it queued for (200). The probe
+        itself is bounded — it answers while the lock stays held."""
+        src, base, _ = served_source
+        tier = AnalysisJobTier(AnalysisEngine(src), base, workers=0)
+        server = GenomicsServiceServer(src, job_tier=tier).start()
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", server.port, timeout=30
+        )
+        try:
+            st, _, body = _get_raw(conn, "/healthz")
+            assert st == 200
+            assert json.loads(body)["checks"]["device_lock"] == "ok"
+            assert tier._engine._device_lock.acquire(timeout=5)
+            try:
+                st, _, body = _get_raw(conn, "/healthz")
+                doc = json.loads(body)
+                assert st == 503 and doc["status"] == "unhealthy"
+                assert doc["checks"]["device_lock"] == "wedged"
+                job, _ = tier.submit(JobSpec(tenant="probe"))
+                with tier._lock:
+                    job.state = "running"
+                st, _, body = _get_raw(conn, "/healthz")
+                doc = json.loads(body)
+                assert st == 200
+                assert doc["checks"]["device_lock"] == "busy"
+                with tier._lock:
+                    job.state = "failed"
+                    job.error = "test teardown"
+            finally:
+                tier._engine._device_lock.release()
+            st, _, _body = _get_raw(conn, "/healthz")
+            assert st == 200
+        finally:
+            conn.close()
+            server.stop()
+            tier.close()
+
+    def test_metrics_scrape_is_schema_valid(self, live):
+        """One real job, then a scrape: Prometheus content type, the
+        shared exposition schema (validate_promtext ↔ validate_trace
+        name-sets), and the PR-16 queue series present."""
+        _src, _base, _tier, _server, conn = live
+        self._run_job(conn)
+        st, headers, body = _get_raw(conn, "/metrics")
+        assert st == 200
+        assert headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4"
+        )
+        text = body.decode()
+        promtext = _load_promtext()
+        assert promtext.validate_prom_text(text, "scrape") == []
+        names = {
+            ln.split("{")[0].split(" ")[0]
+            for ln in text.splitlines()
+            if ln and not ln.startswith("#")
+        }
+        assert "serving_inflight_jobs" in names
+        assert "serving_queue_depth" in names
+        assert "serving_queue_age_seconds_count" in names
+        assert 'kind="pca"' in text  # queue-age series carry the kind
+
+    def test_statusz_snapshot_shape(self, live):
+        _src, _base, tier, _server, conn = live
+        self._run_job(conn)
+        st, _, body = _get_raw(conn, "/statusz")
+        assert st == 200
+        doc = json.loads(body)
+        server_block = doc["server"]
+        assert server_block["uptime_seconds"] >= 0
+        assert server_block["pid"] == os.getpid()
+        assert "git" in doc["build"] and "version" in doc["build"]
+        t = doc["tier"]
+        assert t["jobs_by_state"].get("done", 0) >= 1
+        assert t["resident_job_kinds"].get("pca", 0) >= 1
+        assert t["queue_depth"] == 0
+        assert t["in_flight_by_tenant"] == {}
+        assert t["breakers"] == {"analyze": "closed"}
+        assert t["workers"] == 1
+        assert isinstance(doc["jit_retraces"], int)
+        # Engine was armed without a delta tier in this fixture.
+        assert t["delta_cache"] is None
+
+    def test_statusz_reports_delta_cache_occupancy(
+        self, served_source, tmp_path
+    ):
+        src, base, _ = served_source
+        tier = AnalysisJobTier(
+            AnalysisEngine(src, delta_max_samples=4),
+            base,
+            workers=0,
+        )
+        doc = tier.status()
+        assert doc["delta_cache"] is not None
+        assert doc["delta_cache"]["entries"] == 0
+        assert doc["delta_cache"]["max_bytes"] > 0
+        tier.close()
+
+    def test_job_trace_endpoint_returns_span_timeline(self, live):
+        _src, _base, tier, _server, conn = live
+        jid = self._run_job(conn)
+        st, jd = _get(conn, f"/jobs/{jid}")
+        assert st == 200 and "trace" not in jd  # opt-in only
+        st, jd = _get(conn, f"/jobs/{jid}?trace=1")
+        assert st == 200
+        trace = jd["trace"]
+        assert trace, "trace=1 returned an empty timeline"
+        tids = {ev["args"]["trace_id"] for ev in trace}
+        assert len(tids) == 1  # one job, one trace id
+        names = [ev["name"] for ev in trace]
+        assert "job.run" in names
+        # job_transition (admission instant) precedes the run span.
+        assert names.index("job_transition") < names.index("job.run")
+        tss = [float(ev["ts"]) for ev in trace]
+        assert tss == sorted(tss)
+        st, jd = _get(conn, "/jobs/nope?trace=1")
+        assert st == 404
+
+    def test_trace_ids_minted_per_job_and_shared_on_dedup(
+        self, served_source
+    ):
+        """Distinct submissions get distinct admission-minted ids; a
+        single-flight dedup view shares the active job's id (it IS that
+        execution); the id never perturbs the cohort key."""
+        src, base, _ = served_source
+        with TelemetrySession():
+            tier = AnalysisJobTier(
+                AnalysisEngine(src), base, workers=0
+            )
+            a, created_a = tier.submit(JobSpec(tenant="t", num_pc=2))
+            b, created_b = tier.submit(JobSpec(tenant="t", num_pc=3))
+            assert created_a and created_b
+            assert a.trace_id and b.trace_id
+            assert a.trace_id != b.trace_id
+            dup, created_dup = tier.submit(
+                JobSpec(tenant="t", num_pc=2)
+            )
+            assert not created_dup
+            assert dup.trace_id == a.trace_id
+            spec_x = JobSpec(tenant="t", num_pc=2)
+            assert cohort_key(spec_x, base) == a.key
+            assert "trace_id" not in spec_x.to_record()
+            tier.close()
+
+
+class TestTraceReplayChaosPin:
+    """PR 16 extension of the kill -9 chaos contract: the journal
+    carries the admission-minted trace id, so a replayed job re-emits
+    ITS span timeline — same span names, same order (durations and
+    compile-cache artifacts may differ; ``xla_compile:*`` spans are
+    cache-state, not job semantics, and are excluded)."""
+
+    @staticmethod
+    def _span_sequence(events):
+        return [
+            ev["name"]
+            for ev in events
+            if not ev["name"].startswith("xla_compile:")
+        ]
+
+    def test_replayed_job_reemits_same_span_sequence(
+        self, served_source, tmp_path
+    ):
+        from spark_examples_tpu.serving import SimulatedCrash
+
+        src, base, _ = served_source
+        spec = JobSpec(tenant="chaos", num_pc=3)
+        # Baseline: uninterrupted execution, its trace captured. A
+        # prior warm-up run (different cohort key) pre-compiles the
+        # kernels so the baseline itself is compile-cache-warm.
+        with TelemetrySession():
+            warm = AnalysisJobTier(
+                AnalysisEngine(src), base, workers=0
+            )
+            warm.submit(JobSpec(tenant="chaos", num_pc=2))
+            while warm.step(timeout=0.2):
+                pass
+            warm.close()
+        with TelemetrySession():
+            baseline_tier = AnalysisJobTier(
+                AnalysisEngine(src), base, workers=0
+            )
+            bjob, _ = baseline_tier.submit(spec)
+            while baseline_tier.step(timeout=0.2):
+                pass
+            assert bjob.state == "done"
+            baseline_seq = self._span_sequence(
+                baseline_tier.job_trace(bjob.id)
+            )
+            baseline_tier.close()
+        assert "job.run" in baseline_seq
+
+        # Crash phase: start journaled, kill between the journaled
+        # start and execution (the SIGKILL seam).
+        journal = str(tmp_path / "tracej")
+        with TelemetrySession():
+            tier = AnalysisJobTier(
+                AnalysisEngine(src),
+                base,
+                workers=0,
+                journal_dir=journal,
+            )
+            job, _ = tier.submit(spec)
+            minted = job.trace_id
+            assert minted
+            plan = FaultPlan(
+                seed=1,
+                rules=[
+                    FaultRule(
+                        site="serving.job.kill",
+                        kind="error",
+                        match=job.id,
+                    )
+                ],
+            )
+            with faults.active_plan(plan):
+                with pytest.raises(SimulatedCrash):
+                    tier.step(timeout=0.2)
+            tier._journal.close()
+
+        # Restart: fresh tracer (the real process died), replay
+        # restores the SAME trace id from the journal, and the resumed
+        # execution re-emits the baseline's span sequence under it.
+        with TelemetrySession():
+            resumed = AnalysisJobTier(
+                AnalysisEngine(src),
+                base,
+                workers=0,
+                journal_dir=journal,
+            )
+            replayed = {j.key: j for j in resumed.jobs()}[
+                cohort_key(spec, base)
+            ]
+            assert replayed.trace_id == minted
+            while resumed.step(timeout=0.2):
+                pass
+            assert replayed.state == "done"
+            replay_seq = self._span_sequence(
+                resumed.job_trace(replayed.id)
+            )
+            resumed.close()
+        assert replay_seq == baseline_seq
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -1334,6 +1665,20 @@ class TestServiceChaosSoak:
             finally:
                 proc.send_signal(signal.SIGKILL)
                 proc.wait(timeout=30)
+            # The black box survives the kill: SIGKILL is uncatchable,
+            # so the record is the flight recorder's last PERIODIC
+            # snapshot, written beside the journal.
+            blackbox = os.path.join(
+                journal, "flightrec", "flightrec-last.jsonl"
+            )
+            assert os.path.exists(blackbox), (
+                "kill -9'd analysis server left no flight-recorder "
+                "snapshot"
+            )
+            with open(blackbox) as f:
+                header = json.loads(f.readline())
+            assert header["schema"] == "spark_examples_tpu.flightrec/v1"
+            assert header["reason"] == "periodic"
             # Restart over the same journal: replay re-queues (or
             # re-serves) the job; the result must be bit-identical to
             # the uninterrupted run.
@@ -1359,6 +1704,21 @@ class TestServiceChaosSoak:
                     np.testing.assert_array_equal(
                         np.array([[r[1], r[2]] for r in got]),
                         np.array([[r[1], r[2]] for r in want]),
+                    )
+                # The restarted server reconstructs each job's span
+                # timeline under the journal-restored trace id: the
+                # trace endpoint serves the REPLAYED execution.
+                for want_jid in (jid, jid2):
+                    st, jd = _get(conn, f"/jobs/{want_jid}?trace=1")
+                    assert st == 200
+                    names = [ev["name"] for ev in jd["trace"]]
+                    # Gang members carry the lead's dispatch span, so
+                    # the member-side invariant is the RUNNING
+                    # transition instant every execution path emits
+                    # under the job's restored trace id.
+                    assert "job_transition" in names, (
+                        f"replayed job {want_jid} has no span timeline "
+                        "after restart"
                     )
             finally:
                 proc.terminate()

@@ -99,6 +99,41 @@ def extract_span_names(project: Project) -> Dict[str, List[Tuple[str, int]]]:
     return names
 
 
+def extract_instant_names(
+    project: Project,
+) -> Dict[str, List[Tuple[str, int]]]:
+    """Literal instant name -> [(path, line), ...] across the scope.
+
+    The ``pod.*`` family is emitted through ``instant(...)``, not
+    ``span(...)`` — the timestamp pairs are points, not durations — so
+    the closed-set cross-check needs its own call scan."""
+    names: Dict[str, List[Tuple[str, int]]] = {}
+    for top in project.rule_paths(NAME, DEFAULT_PATHS):
+        for rel in project.walk(top):
+            ctx = project.file(rel)
+            if ctx is None or ctx.tree is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = call_name(node)
+                is_instant = (
+                    cname == "instant"
+                    or (cname is not None and cname.endswith(".instant"))
+                    or (
+                        cname is None
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "instant"
+                    )
+                )
+                if not is_instant:
+                    continue
+                lit = literal_str(node.args[0]) if node.args else None
+                if lit is not None:
+                    names.setdefault(lit, []).append((rel, node.lineno))
+    return names
+
+
 def extract_metric_registrations(
     project: Project,
 ) -> Dict[str, List[Tuple[str, int, str, Set[str]]]]:
@@ -156,8 +191,9 @@ class SpanContractRule:
     code = CODE
     summary = (
         "spans are context-managed; ingest.*/job.*/gramian.sparse.*/"
-        "pairhmm.* span names and wire/ingest/serving/sparse metric "
-        "registrations match scripts/validate_trace.py exactly"
+        "pairhmm.* span names, pod.* instant names, and wire/ingest/"
+        "serving/sparse metric registrations match "
+        "scripts/validate_trace.py exactly"
     )
     project_wide = True
 
@@ -225,6 +261,40 @@ class SpanContractRule:
                         "emission",
                     )
                 )
+        # 3b. The pod.* instant family, both directions — same closed-
+        # set discipline, over instant() calls instead of span() calls
+        # (merge_pod_trace.py keys its clock alignment on these names).
+        instant_names = extract_instant_names(project)
+        pod_schema: Set[str] = set(getattr(schema, "_POD_INSTANTS", set()))
+        pod_emitted = {
+            n for n in instant_names if n.startswith("pod.")
+        }
+        for name in sorted(pod_emitted - pod_schema):
+            rel, line = instant_names[name][0]
+            findings.append(
+                Finding(
+                    NAME,
+                    CODE,
+                    rel,
+                    line,
+                    f"instant {name!r} is not in validate_trace."
+                    "_POD_INSTANTS — artifacts carrying it fail the "
+                    "runtime schema gate; add it to the schema in the "
+                    "same change",
+                )
+            )
+        for name in sorted(pod_schema - pod_emitted):
+            findings.append(
+                Finding(
+                    NAME,
+                    CODE,
+                    SCHEMA_SCRIPT,
+                    _schema_line(project, f'"{name}"'),
+                    f"schema pod instant {name!r} is emitted nowhere "
+                    "in the tree (literal scan) — dead schema entries "
+                    "hide renames; remove it or restore the emission",
+                )
+            )
         # 4-5. Metric contract: required names registered, with the
         # labels the schema's sample checks demand.
         regs = extract_metric_registrations(project)
@@ -243,9 +313,11 @@ class SpanContractRule:
         # Serving/resilience counters: the schema names the label each
         # sample must carry (breaker probes, job outcomes, sheds).
         required.update(getattr(schema, "_LABELED_COUNTERS", {}))
-        # Plain serving histograms: registration required, no label
-        # contract (None = skip the label check).
+        # Plain serving histograms and gauges: registration required,
+        # no label contract (None = skip the label check).
         for name in getattr(schema, "_SERVING_HISTOGRAMS", ()):
+            required[name] = None
+        for name in getattr(schema, "_SERVING_GAUGES", ()):
             required[name] = None
         for name, label in sorted(required.items()):
             sites = regs.get(name)
