@@ -11,10 +11,18 @@
 # coordinates bit-identical to the uninterrupted run
 # (tests/test_serving.py::TestServiceChaosSoak).
 #
+# Round 17 adds a THIRD leg: the replica failover soak — two real
+# server processes behind one --store-dir, kill -9 either one mid-job,
+# the survivor adopts its journal and must serve coordinates
+# bit-identical to the uninterrupted baseline
+# (tests/test_replica.py::TestReplicaChaosSoak).
+#
 # Usage:
-#   scripts/chaos_soak.sh                 # CHAOS_SOAK_ITERS=5, SERVICE_SOAK_ITERS=2
+#   scripts/chaos_soak.sh                 # CHAOS_SOAK_ITERS=5, SERVICE_SOAK_ITERS=2,
+#                                         # REPLICA_SOAK_ITERS=2
 #   CHAOS_SOAK_ITERS=25 scripts/chaos_soak.sh
 #   SERVICE_SOAK_ITERS=10 scripts/chaos_soak.sh
+#   REPLICA_SOAK_ITERS=10 scripts/chaos_soak.sh
 #   scripts/chaos_soak.sh -k randomized   # extra pytest args pass through
 #
 # The deterministic resilience + serving suites (tier-1) live in the
@@ -27,6 +35,7 @@ cd "$(dirname "$0")/.."
 
 : "${CHAOS_SOAK_ITERS:=5}"
 : "${SERVICE_SOAK_ITERS:=2}"
+: "${REPLICA_SOAK_ITERS:=2}"
 
 # Each leg tolerates pytest exit 5 ("no tests matched") so a -k filter
 # aimed at one leg doesn't fail the other — but BOTH matching nothing
@@ -38,6 +47,7 @@ run_leg() {
     env JAX_PLATFORMS=cpu \
         CHAOS_SOAK_ITERS="$CHAOS_SOAK_ITERS" \
         SERVICE_SOAK_ITERS="$SERVICE_SOAK_ITERS" \
+        REPLICA_SOAK_ITERS="$REPLICA_SOAK_ITERS" \
         python -m pytest "$1" -q -m slow -p no:cacheprovider \
         "${@:2}" || rc=$?
     if [ "$rc" = 5 ]; then
@@ -49,6 +59,7 @@ run_leg() {
 
 run_leg tests/test_resilience.py "$@"
 run_leg tests/test_serving.py "$@"
+run_leg tests/test_replica.py "$@"
 
 if [ "$ran" = 0 ]; then
     echo "chaos_soak: no tests matched in either leg" >&2

@@ -64,6 +64,8 @@ _JOB_SPANS = {
                    # (added/removed sample counts in args)
     "job.gang",    # one gang-batched Gramian dispatch (size + member
                    # job ids in args)
+    "job.adopt",   # one expired-peer journal adoption (peer replica id
+                   # + fencing token in args)
 }
 
 # Sparse-aware Gramian span contract (ops/sparse.py + the mesh-tiled
@@ -272,6 +274,9 @@ _LABELED_COUNTERS = {
                                           # length bucket (rRxhH)
     "serving_delta_jobs_total": "outcome",  # hit/fallback/miss
     "serving_jobs_total": "outcome",      # done/failed/cached/deduped
+    "serving_lease_total": "outcome",     # acquired/renewed/lost/takeover/
+                                          # degraded/recovered/released/
+                                          # rejected_write
     "serving_shed_total": "reason",       # queue_full/quota
     "sparse_gramian_windows_total": "route",  # scatter/dense per window
     "sparse_pod_coalesced_windows_total": "mode",  # gang/solo per step
@@ -294,6 +299,7 @@ _SERVING_HISTOGRAMS = (
 _SERVING_GAUGES = (
     "serving_inflight_jobs",
     "serving_queue_depth",
+    "serving_store_degraded",
 )
 
 

@@ -395,7 +395,19 @@ def _cmd_pca_bridge(args) -> int:
 def _analysis_tier(args, source):
     """The --analyze job tier: re-entrant PCA engine over the served
     source + bounded admission + crash-safe journal (serving/)."""
-    from spark_examples_tpu.serving import AnalysisEngine, AnalysisJobTier
+    from spark_examples_tpu.serving import (
+        DEFAULT_HEARTBEAT_S,
+        DEFAULT_LEASE_TTL_S,
+        AnalysisEngine,
+        AnalysisJobTier,
+        LeaseManager,
+    )
+
+    _REPLICA_FLAG_DEFAULTS = {
+        "--replica-id": None,
+        "--replica-lease-ttl": DEFAULT_LEASE_TTL_S,
+        "--replica-heartbeat": DEFAULT_HEARTBEAT_S,
+    }
 
     # Loud validation before any work, like every other flag surface
     # (--prefetch-depth/--ingest-workers discipline): a zero-worker
@@ -416,6 +428,32 @@ def _analysis_tier(args, source):
             raise SystemExit(
                 f"{flag} must be >= 0 (0 disables), got {value}"
             )
+    if args.store_dir is None:
+        # --replica-* only mean something over a shared store; a
+        # silently ignored flag is how operators think they deployed
+        # failover and discover otherwise during an outage.
+        for flag, value in (
+            ("--replica-id", args.replica_id),
+            ("--replica-lease-ttl", args.replica_lease_ttl),
+            ("--replica-heartbeat", args.replica_heartbeat),
+        ):
+            if value is not None and value != _REPLICA_FLAG_DEFAULTS[flag]:
+                raise SystemExit(
+                    f"{flag} requires --store-dir (replicated serving "
+                    "needs a shared durable store)"
+                )
+    else:
+        if args.replica_lease_ttl <= 0:
+            raise SystemExit(
+                "--replica-lease-ttl must be > 0, got "
+                f"{args.replica_lease_ttl}"
+            )
+        if not 0 < args.replica_heartbeat < args.replica_lease_ttl:
+            raise SystemExit(
+                "--replica-heartbeat must satisfy 0 < heartbeat < "
+                f"lease ttl ({args.replica_lease_ttl}), got "
+                f"{args.replica_heartbeat}"
+            )
     # Jobs jit-compile on demand; the persistent cache means job #1
     # after a restart pays no recompile either.
     _enable_compile_cache()
@@ -427,7 +465,7 @@ def _analysis_tier(args, source):
     base = pca_config_from_args(args)
     if not args.variant_set_ids:
         base.variant_set_ids = [DEFAULT_VARIANT_SET_ID]
-    if not args.analyze_journal_dir:
+    if not args.analyze_journal_dir and not args.store_dir:
         print(
             "WARNING: --analyze without --analyze-journal-dir: jobs are "
             "in-memory only and a crash forgets them all.",
@@ -435,21 +473,47 @@ def _analysis_tier(args, source):
         )
     import os
 
-    # The delta cache persists beside the journal: a kill -9'd server
-    # restarted on the same --analyze-journal-dir answers ±k cohort
-    # deltas warm (checksummed write-through; torn entries drop loudly
-    # to cold on re-load).
-    delta_persist = (
-        os.path.join(args.analyze_journal_dir, "deltas")
-        if args.analyze_journal_dir and args.delta_max_samples > 0
-        else None
-    )
+    replica = None
+    delta_fence = None
+    if args.store_dir:
+        from spark_examples_tpu.store import LocalDirStore
+
+        replica = LeaseManager(
+            LocalDirStore(args.store_dir),
+            replica_id=args.replica_id,
+            ttl_s=args.replica_lease_ttl,
+            heartbeat_s=args.replica_heartbeat,
+        )
+        if not replica.start():
+            # Degraded from birth (store unreachable): the tier still
+            # comes up — single-replica local mode, journal/ckpt on
+            # local disk, serving_store_degraded=1. Restart with a
+            # reachable store to rejoin the replica set.
+            print(
+                "WARNING: --store-dir unreachable at startup; serving "
+                "single-replica local (restart with a reachable store "
+                "to rejoin the replica set).",
+                file=sys.stderr,
+            )
+        delta_fence = replica.check_fence
+    # The delta cache persists beside the journal — or, replicated, in
+    # the shared store so a warm delta computed on one replica answers
+    # on all: a kill -9'd server restarted on the same directory
+    # answers ±k cohort deltas warm (checksummed write-through; torn
+    # entries drop loudly to cold on re-load).
+    if args.store_dir and args.delta_max_samples > 0:
+        delta_persist = os.path.join(args.store_dir, "deltas")
+    elif args.analyze_journal_dir and args.delta_max_samples > 0:
+        delta_persist = os.path.join(args.analyze_journal_dir, "deltas")
+    else:
+        delta_persist = None
     tier = AnalysisJobTier(
         AnalysisEngine(
             source,
             mesh=mesh,
             delta_max_samples=args.delta_max_samples,
             delta_persist_dir=delta_persist,
+            delta_fence=delta_fence,
         ),
         base,
         queue_depth=args.analyze_queue_depth,
@@ -458,6 +522,7 @@ def _analysis_tier(args, source):
         journal_dir=args.analyze_journal_dir,
         cache_size=args.analyze_cache_size,
         gang_max_samples=args.gang_max_samples,
+        replica=replica,
     )
     return tier.start()
 
@@ -571,6 +636,14 @@ def _cmd_serve_cohort(args) -> int:
                     f", gangs <= {args.gang_max_samples} samples"
                     if args.gang_max_samples > 0
                     else ", gangs off"
+                )
+                + (
+                    ", replica "
+                    f"{job_tier.replica_health()['replica_id']} on "
+                    f"store {args.store_dir} (lease ttl "
+                    f"{args.replica_lease_ttl:g}s)"
+                    if args.store_dir
+                    else ""
                 ),
                 flush=True,
             )

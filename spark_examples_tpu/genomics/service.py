@@ -234,7 +234,35 @@ def _make_handler(source, token: Optional[str], job_tier=None):
             job_id = path[len("/jobs/"):]
             rec = job_tier.job_record(job_id)
             if rec is None:
-                self.send_error(404, "no such job")
+                # Replicated mode: a job admitted by a PEER replica is
+                # findable through the shared store index — polling any
+                # replica behind one load balancer works. 503 + Retry-
+                # After (never a lying 404) when the store is
+                # unreachable: the job may well exist.
+                peer_lookup = getattr(job_tier, "peer_job_record", None)
+                if peer_lookup is not None:
+                    from spark_examples_tpu.store import StoreError
+
+                    try:
+                        rec = peer_lookup(job_id)
+                    except StoreError as e:
+                        self._send_json(
+                            503,
+                            {
+                                "error": str(e),
+                                "reason": "store_degraded",
+                            },
+                            retry_after=5.0,
+                        )
+                        return
+                if rec is None:
+                    self.send_error(404, "no such job")
+                    return
+                if q.get("trace") in ("1", "true"):
+                    # The owning replica holds this job's timeline; the
+                    # index record carries only its trace id.
+                    rec["trace"] = []
+                self._send_json(200, rec)
                 return
             if q.get("trace") in ("1", "true"):
                 # The job's span timeline: every tracer event carrying
@@ -290,6 +318,19 @@ def _make_handler(source, token: Optional[str], job_tier=None):
                     else ("busy" if running else "wedged")
                 )
                 healthy = journal_ok and not wedged
+                replica = getattr(
+                    job_tier, "replica_health", lambda: None
+                )()
+                if replica is not None:
+                    # In-memory lease bits only — no store I/O in a
+                    # health probe. A zombie (lease lost) must FAIL
+                    # liveness so the balancer routes clients to the
+                    # replica that now owns its jobs; degraded-but-
+                    # leased keeps serving (single-replica local mode).
+                    checks["replica"] = replica
+                    healthy = (
+                        healthy and replica["lease_state"] != "lost"
+                    )
             self._send_json(
                 200 if healthy else 503,
                 {

@@ -47,6 +47,17 @@ detected from identical gathered data AFTER the same phase on every
 process, so all peers stop at the same point in the frame sequence and
 no socket is left holding half-read frames — the next stream starts on
 clean pipes.
+
+Peer DEATH (kill -9, OOM, host loss) is the one failure that cannot be
+agreed from gathered data — the peer stops posting mid-sequence. The
+hardened receive path converts it into the same shape: EOF/ECONNRESET
+on an established socket raises :class:`PodPeerDeadError`, the gather
+paths synthesize the dead peer's −2 header/confirm (the exact
+producer-failure encoding every process already raises on together),
+and the mesh tears its sockets down so every survivor detects within
+one receive instead of one phase apart — all survivors raise at the
+same slot. The mesh is poisoned afterwards: a pod minus a member is
+fail-stop + relaunch, never a silent continue.
 """
 
 from __future__ import annotations
@@ -64,6 +75,7 @@ import numpy as np
 
 __all__ = [
     "POD_EXCHANGE_TIMEOUT_S",
+    "PodPeerDeadError",
     "PodWindowExchange",
     "SlotPipeline",
     "coordination_client",
@@ -89,6 +101,23 @@ _KIND_CHECK = 3
 
 # stream (q), step (q), kind (B), byte length (q) — little-endian.
 _FRAME = struct.Struct("<qqBq")
+
+
+class PodPeerDeadError(RuntimeError):
+    """An established peer socket died mid-protocol (EOF/ECONNRESET —
+    the peer process was killed, OOMed, or its host vanished).
+
+    Distinct from the generic protocol-desync/timeout RuntimeErrors so
+    the gather paths can CONVERT it into the synchronized −2 failure
+    shape every process already handles (producer-error semantics:
+    raise together at the same slot) instead of each survivor hanging
+    out its own receive deadline one phase apart. ``peer`` is the dead
+    process index when known.
+    """
+
+    def __init__(self, message: str, peer: Optional[int] = None) -> None:
+        super().__init__(message)
+        self.peer = peer
 
 
 def coordination_client() -> Any:
@@ -195,6 +224,7 @@ class _PodSocketMesh:
         self._socks: Dict[int, socket.socket] = {}
         self._senders: Dict[int, _PeerSender] = {}
         self.poisoned = False
+        self.poison_reason = ""
         self._connect(timeout_s)
 
     def poison(self) -> None:
@@ -209,6 +239,10 @@ class _PodSocketMesh:
         contract is what it always was for one-sided death: fail-stop
         + relaunch (docs/ARCHITECTURE.md §5)."""
         self.poisoned = True
+        self.poison_reason = (
+            "an abandoned stream (one-sided consumer failure); the "
+            "socket pipes may hold half-read frames"
+        )
 
     @classmethod
     def instance(cls, timeout_s: float) -> Optional["_PodSocketMesh"]:
@@ -216,11 +250,13 @@ class _PodSocketMesh:
             if cls._instance is not None:
                 if cls._instance.poisoned:
                     raise RuntimeError(
-                        "pod exchange mesh was poisoned by an "
-                        "abandoned stream (one-sided consumer "
-                        "failure); the socket pipes may hold "
-                        "half-read frames — pod recovery is fail-stop "
-                        "+ relaunch (docs/ARCHITECTURE.md §5)"
+                        "pod exchange mesh was poisoned by "
+                        + (
+                            cls._instance.poison_reason
+                            or "an abandoned stream"
+                        )
+                        + " — pod recovery is fail-stop + relaunch "
+                        "(docs/ARCHITECTURE.md §5)"
                     )
                 return cls._instance
             client = coordination_client()
@@ -327,12 +363,36 @@ class _PodSocketMesh:
         while len(buf) < n:
             chunk = sock.recv(n - len(buf))
             if not chunk:
-                raise RuntimeError(
+                raise PodPeerDeadError(
                     "pod exchange peer closed its connection "
                     "mid-protocol (peer process died?)"
                 )
             buf.extend(chunk)
         return bytes(buf)
+
+    def _peer_died(self, peer: int) -> None:
+        """Peer-death cascade: poison the mesh (a member is gone — the
+        pod's recovery contract is fail-stop + relaunch) and close every
+        socket, so survivors blocked reading THIS process unblock with
+        EOF immediately and convert the same way. Without the cascade,
+        survivor A can detect the death one phase ahead of survivor B,
+        stop posting, and leave B hanging out the full receive deadline
+        waiting on A — the staggered-raise shape the −2 protocol
+        exists to prevent."""
+        self.poisoned = True
+        self.poison_reason = (
+            f"the death of pod process {peer} mid-protocol (mesh "
+            "sockets torn down for the synchronized raise)"
+        )
+        for s in self._socks.values():
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def post(
         self, peer: int, stream: int, step: int, kind: int, body: bytes
@@ -370,6 +430,18 @@ class _PodSocketMesh:
                 f"(stream {stream} step {step} kind {kind}) after "
                 f"{self._timeout_s:.0f}s; a lockstep collective would "
                 "have hung here forever — check the peer's log"
+            ) from e
+        except (PodPeerDeadError, OSError) as e:
+            # EOF or ECONNRESET/EPIPE on an ESTABLISHED socket: the
+            # peer process died. Attribute it, cascade the teardown
+            # (every survivor must detect within one recv, not one
+            # phase later), and let the gather paths convert it into
+            # the synchronized −2 failure shape.
+            self._peer_died(peer)
+            raise PodPeerDeadError(
+                f"pod exchange peer {peer} died mid-protocol "
+                f"(stream {stream} step {step} kind {kind}): {e}",
+                peer=peer,
             ) from e
 
 
@@ -434,16 +506,32 @@ class PodWindowExchange:
 
     def gather_headers(self, step: int, n_fields: int) -> np.ndarray:
         """(world, n_fields) int64 — every process's step header (own
-        row included, like the allgather it replaces)."""
+        row included, like the allgather it replaces).
+
+        A peer that DIED (EOF/ECONNRESET on its established socket)
+        contributes a synthesized all-−2 row: field 0 = −2 is exactly
+        the producer-failure shape the consumer already raises on
+        everywhere together, so peer death fails the whole pod at this
+        slot instead of stranding survivors in later phases. The mesh
+        teardown inside the failed recv cascades the detection to every
+        survivor within one receive."""
         rows: List[Optional[np.ndarray]] = [None] * self._world
         recv_unix: Dict[int, float] = {}
         for p in range(self._world):
             if p == self._pid:
                 continue
-            rows[p] = np.frombuffer(
-                self._mesh.recv(p, self._stream, step, _KIND_HEADER),
-                dtype=np.int64,
-            )
+            try:
+                rows[p] = np.frombuffer(
+                    self._mesh.recv(
+                        p, self._stream, step, _KIND_HEADER
+                    ),
+                    dtype=np.int64,
+                )
+            except PodPeerDeadError as e:
+                print(f"WARNING: {e}; converting to the synchronized "
+                      "-2 failure shape.", flush=True)
+                rows[p] = np.full(n_fields, -2, np.int64)
+                continue
             recv_unix[p] = time.time()
         # One instant per peer AFTER the loop — the recv path itself
         # stays untouched. send_unix is when WE posted this step's
@@ -479,16 +567,25 @@ class PodWindowExchange:
         )
 
     def gather_confirms(self, step: int) -> np.ndarray:
-        """(world,) int64 — 0 ok / −2 payload-construction failure."""
+        """(world,) int64 — 0 ok / −2 payload-construction failure (a
+        DEAD peer reads as −2 too: same synchronized fail-everywhere
+        raise, see :meth:`gather_headers`)."""
         vals = np.empty(self._world, np.int64)
         for p in range(self._world):
             if p == self._pid:
                 vals[p] = self._own_confirm
                 continue
-            vals[p] = np.frombuffer(
-                self._mesh.recv(p, self._stream, step, _KIND_CONFIRM),
-                dtype=np.int64,
-            )[0]
+            try:
+                vals[p] = np.frombuffer(
+                    self._mesh.recv(
+                        p, self._stream, step, _KIND_CONFIRM
+                    ),
+                    dtype=np.int64,
+                )[0]
+            except PodPeerDeadError as e:
+                print(f"WARNING: {e}; converting to the synchronized "
+                      "-2 failure shape.", flush=True)
+                vals[p] = -2
         return vals
 
     def post_check(self, step: int, digest: int) -> None:

@@ -53,7 +53,20 @@ Sites wired in this round (glob-matched, so ``transport.*`` works):
                                delete (leaves stale subset lanes)
 ``fixture.stream``          FixtureSource per-shard streams (the migrated
                             ``fail_shards`` hook)
+``store.read``              durable-store object reads (error/stall)
+``store.write``             durable-store object puts (torn = kill -9
+                            mid-write: the framed blob truncates under its
+                            ``.tmp-`` name and never renames)
+``store.lease``             lease CAS operations, keyed ``<op>:<name>``
+                            (error/stall; ``corrupt`` is locally
+                            interpreted as a STALE FENCING TOKEN — the
+                            op raises ``FencedWriteError``, the zombie-
+                            write shape)
 ==========================  =================================================
+
+The serving seams (``serving.job.run``/``serving.job.kill``/
+``serving.journal.append``) and the store seams' failure semantics are
+documented in docs/RESILIENCE.md.
 """
 
 from __future__ import annotations

@@ -35,6 +35,12 @@ from spark_examples_tpu.serving.queue import (
     QueueFullError,
     QuotaExceededError,
 )
+from spark_examples_tpu.serving.replica import (
+    DEFAULT_HEARTBEAT_S,
+    DEFAULT_LEASE_TTL_S,
+    LeaseManager,
+    generate_replica_id,
+)
 from spark_examples_tpu.serving.tier import AnalysisJobTier, SimulatedCrash
 
 __all__ = [
@@ -42,15 +48,19 @@ __all__ = [
     "AdmissionQueue",
     "AnalysisEngine",
     "AnalysisJobTier",
+    "DEFAULT_HEARTBEAT_S",
+    "DEFAULT_LEASE_TTL_S",
     "DeltaIndex",
     "Job",
     "JobJournal",
     "JobSpec",
     "JournalUnavailableError",
+    "LeaseManager",
     "QueueFullError",
     "QuotaExceededError",
     "SimulatedCrash",
     "cohort_key",
+    "generate_replica_id",
     "gramian_base_key",
     "job_config",
 ]

@@ -36,7 +36,16 @@ import json
 import os
 import sys
 import threading
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
 
 import numpy as np
 
@@ -161,6 +170,15 @@ class DeltaIndex:
     in-memory checksum guard). The window cache is NOT persisted: the
     first delta against a re-loaded ancestor re-streams host ingest
     once and re-captures.
+
+    In replicated serving the persist dir lives on the shared store, so
+    the write-through is cross-replica: a warm delta computed on one
+    replica answers on all. Two extra pieces make that safe and useful:
+    ``fence`` (a zero-arg callable raising ``FencedWriteError`` when
+    this process lost its lease) gates every persisted write — a
+    zombie's Gramian never lands in the shared tier — and a resolve
+    MISS rescans the directory for entries peers persisted since our
+    last look before answering cold.
     """
 
     def __init__(
@@ -169,6 +187,7 @@ class DeltaIndex:
         max_bytes: int = _GRAMIAN_CACHE_BYTES,
         max_window_bytes: int = _WINDOW_CACHE_BYTES,
         persist_dir: Optional[str] = None,
+        fence: Optional[Callable[[], None]] = None,
     ) -> None:
         self.max_delta_samples = max(0, max_delta_samples)
         self.max_bytes = max(1, max_bytes)
@@ -185,9 +204,20 @@ class DeltaIndex:
         )
         self._window_bytes: Dict[str, int] = {}
         self._persist_dir = persist_dir
+        self._fence = fence
+        # Persisted filenames already loaded (or written) by THIS
+        # process — the rescan-on-miss skips them, so a rescan costs
+        # one listdir plus only the files peers added since.
+        self._seen_files: Set[str] = set()
         if persist_dir is not None:
             os.makedirs(persist_dir, exist_ok=True)
-            self._load_persisted()
+            loaded = self._load_persisted(sweep_partials=True)
+            if loaded:
+                print(
+                    f"Delta cache re-loaded: {loaded} persisted Gramian "
+                    f"entr{'y' if loaded == 1 else 'ies'} "
+                    f"(warm ±k answers survive the restart)."
+                )
 
     # -- persistence ----------------------------------------------------------
 
@@ -212,10 +242,17 @@ class DeltaIndex:
 
     def _persist_entry(self, entry: DeltaEntry) -> None:
         """Write one entry through to disk (atomic: a kill mid-write
-        leaves only a ``.tmp-`` partial the next load sweeps)."""
+        leaves only a ``.tmp-`` partial the next load sweeps).
+
+        The fence runs FIRST and outside the OSError handler on
+        purpose: ``FencedWriteError`` is RuntimeError-shaped, so the
+        disk-weather catch below can never degrade a zombie's rejected
+        write into a warning."""
         path = self._entry_path(entry)
         if path is None:
             return
+        if self._fence is not None:
+            self._fence()
         tmp = f"{path}.tmp-{os.getpid()}"
         try:
             with open(tmp, "wb") as f:
@@ -229,6 +266,8 @@ class DeltaIndex:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)
+            # Our own write needs no rescan pickup.
+            self._seen_files.add(os.path.basename(path))
         except OSError as e:
             # Disk weather costs only restart warmth, never a result.
             print(
@@ -250,23 +289,34 @@ class DeltaIndex:
         except OSError:
             pass
 
-    def _load_persisted(self) -> None:
-        """Re-load persisted entries, loudest-possible skepticism: any
-        unreadable/torn/checksum-mismatched file is warned about and
-        unlinked — the affected cohort runs cold, exactly as if the
-        entry had never been written."""
+    def _load_persisted(self, sweep_partials: bool = False) -> int:
+        """Load persisted entries this process has not seen yet,
+        loudest-possible skepticism: any unreadable/torn/checksum-
+        mismatched file is warned about and unlinked — the affected
+        cohort runs cold, exactly as if the entry had never been
+        written. Returns the number of entries loaded.
+
+        ``sweep_partials`` is startup-only: on a SHARED persist dir a
+        ``.tmp-`` file seen mid-run may be a live peer's in-progress
+        write, so rescans leave partials alone (the writer's rename
+        makes them visible atomically)."""
         assert self._persist_dir is not None
         loaded = 0
-        for name in sorted(os.listdir(self._persist_dir)):
+        try:
+            names = sorted(os.listdir(self._persist_dir))
+        except OSError:
+            return 0
+        for name in names:
             path = os.path.join(self._persist_dir, name)
             if ".tmp-" in name:
-                # A kill mid-persist's partial: never parse, just sweep.
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
+                if sweep_partials:
+                    # A kill mid-persist's partial: never parse, sweep.
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
                 continue
-            if not name.endswith(".npz"):
+            if not name.endswith(".npz") or name in self._seen_files:
                 continue
             try:
                 with np.load(path, allow_pickle=False) as doc:
@@ -313,13 +363,9 @@ class DeltaIndex:
                 # re-verify, and re-evict the same dead entries.
                 if gone is not entry:
                     self._unlink_entry(gone)
+            self._seen_files.add(name)
             loaded += 1
-        if loaded:
-            print(
-                f"Delta cache re-loaded: {loaded} persisted Gramian "
-                f"entr{'y' if loaded == 1 else 'ies'} "
-                f"(warm ±k answers survive the restart)."
-            )
+        return loaded
 
     def _evict_over_budget_locked(self) -> List[DeltaEntry]:
         """Pop LRU entries past the byte budget; the caller unlinks the
@@ -344,7 +390,30 @@ class DeltaIndex:
         """Nearest cached ancestor: same base key, sample-set symmetric
         difference ≤ ``max_delta_samples`` (0 = exact frame, the
         num_pc-tweak case). Ties prefer the smallest difference, then
-        the most recently used. Returns None when nothing qualifies."""
+        the most recently used. Returns None when nothing qualifies.
+
+        On a MISS with shared persistence armed, the directory is
+        rescanned first — a peer replica may have persisted exactly
+        this ancestor since our last look (one listdir; already-seen
+        files are skipped) — and resolution retried once."""
+        best = self._resolve_once(base_key, samples)
+        if best is None and self._persist_dir is not None:
+            try:
+                fresh = self._load_persisted()
+            except Exception:  # noqa: BLE001 — rescan is best-effort
+                fresh = 0
+            if fresh:
+                print(
+                    f"Delta cache rescanned: {fresh} entr"
+                    f"{'y' if fresh == 1 else 'ies'} persisted by peer "
+                    "replica(s) picked up."
+                )
+                best = self._resolve_once(base_key, samples)
+        return best
+
+    def _resolve_once(
+        self, base_key: str, samples: Sequence[str]
+    ) -> Optional[DeltaEntry]:
         want = set(samples)
         with self._lock:
             best: Optional[DeltaEntry] = None

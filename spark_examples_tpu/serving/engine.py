@@ -29,7 +29,7 @@ import collections
 import dataclasses
 import sys
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -116,6 +116,7 @@ class AnalysisEngine:
         mesh: Any = None,
         delta_max_samples: int = 0,
         delta_persist_dir: Optional[str] = None,
+        delta_fence: Optional[Callable[[], None]] = None,
     ) -> None:
         self.source = source
         self.mesh = mesh
@@ -126,12 +127,17 @@ class AnalysisEngine:
         self._indexes: "collections.OrderedDict[Tuple[str, ...], object]" = (
             collections.OrderedDict()
         )
-        # delta_persist_dir (normally <journal dir>/deltas) arms the
-        # write-through tier: finished Gramians survive a kill -9 and
-        # re-load checksum-verified on restart (serving/deltas.py).
+        # delta_persist_dir (normally <journal dir>/deltas; in
+        # replicated serving <store root>/deltas, shared by every
+        # replica) arms the write-through tier: finished Gramians
+        # survive a kill -9 and re-load checksum-verified on restart
+        # (serving/deltas.py). delta_fence gates those shared writes in
+        # replicated mode — a zombie's Gramian is rejected loudly.
         self._deltas: Optional[DeltaIndex] = (
             DeltaIndex(
-                delta_max_samples, persist_dir=delta_persist_dir
+                delta_max_samples,
+                persist_dir=delta_persist_dir,
+                fence=delta_fence,
             )
             if delta_max_samples > 0 and mesh is None
             else None

@@ -25,16 +25,32 @@ with ``POST /analyze`` + ``GET /jobs/<id>``:
   job also gets a per-job checkpoint dir, so a job killed mid-Gramian
   resumes from its last shard-group snapshot instead of from zero.
 
+Since the replica round the tier also has a **replicated mode**: give
+it a :class:`~spark_examples_tpu.serving.replica.LeaseManager` over a
+shared :class:`~spark_examples_tpu.store.DurableStore` and the journal
+moves to a per-replica directory on the store, every submission and
+terminal transition is mirrored into a shared job index
+(``jobs/<id>``, fenced puts), per-job Gramian checkpoints live on the
+store so ANY replica can resume them, and expired peers' journals are
+adopted (:meth:`AnalysisJobTier.adopt_expired_peers`): their in-flight
+jobs re-queue here in original submission order. A replica that lost
+its lease is a zombie — every journal/index/result write it attempts is
+rejected loudly with ``FencedWriteError``, never torn-merged.
+
 Fault seams (docs/RESILIENCE.md): ``serving.job.run`` (error/stall =
 job execution failure/slow job), ``serving.job.kill`` (a simulated
 process death between the journaled start and execution — the
-deterministic stand-in for ``kill -9`` the chaos tests drive), and
-``serving.journal.append`` (torn/error journal writes).
+deterministic stand-in for ``kill -9`` the chaos tests drive),
+``serving.journal.append`` (torn/error journal writes), and the
+``store.read``/``store.write``/``store.lease`` seams under the
+replicated mode.
 """
 
 from __future__ import annotations
 
 import collections
+import json
+import os
 import shutil
 import sys
 import threading
@@ -59,6 +75,11 @@ from spark_examples_tpu.serving.queue import (
     DEFAULT_QUEUE_DEPTH,
     DEFAULT_TENANT_QUOTA,
 )
+from spark_examples_tpu.serving.replica import (
+    JOB_INDEX_PREFIX,
+    LeaseManager,
+)
+from spark_examples_tpu.store import FencedWriteError, Lease, StoreError
 from spark_examples_tpu.utils.lockcheck import assert_lock_held
 
 __all__ = [
@@ -146,6 +167,7 @@ class AnalysisJobTier:
         breakers: Any = None,
         job_retention: int = DEFAULT_JOB_RETENTION,
         gang_max_samples: int = 0,
+        replica: Optional[LeaseManager] = None,
     ) -> None:
         from spark_examples_tpu.resilience import BreakerSet
 
@@ -163,6 +185,22 @@ class AnalysisJobTier:
         self._by_key: Dict[str, str] = {}  # active cohort_key → job id
         self._retention = max(1, job_retention)
         self._seq = 0
+        # Replicated mode: the journal moves to THIS replica's directory
+        # on the shared store, and Gramian checkpoints become shared —
+        # any replica can resume them after adopting the job. A replica
+        # plane that started degraded (store unreachable) falls back to
+        # the local journal_dir: single-replica local mode, never a
+        # crash.
+        self._replica = replica
+        self._store_root: Optional[str] = None
+        self._peer_scan_monotonic = 0.0
+        if replica is not None and not replica.degraded():
+            root = getattr(replica.store, "root", None)
+            if root is not None:
+                self._store_root = str(root)
+                journal_dir = os.path.join(
+                    self._store_root, "replicas", replica.replica_id
+                )
         self._journal = (
             JobJournal(journal_dir) if journal_dir else None
         )
@@ -198,6 +236,8 @@ class AnalysisJobTier:
             t.join(timeout=10.0)
         if self._journal is not None:
             self._journal.close()
+        if self._replica is not None:
+            self._replica.stop()
 
     # -- submission -----------------------------------------------------------
 
@@ -274,23 +314,76 @@ class AnalysisJobTier:
         # returns — the client-visible contract holds.
         if self._journal is not None:
             try:
-                self._journal.append(
-                    {
-                        "e": "submit",
-                        "id": job.id,
-                        "seq": seq,
-                        "key": key,
-                        "spec": spec.to_record(),
-                        "ts": job.submitted_unix,
-                        "trace": job.trace_id,
-                    }
+                self._fence_check()
+                self._journal.append(self._submit_event(job))
+            except FencedWriteError:
+                # A zombie must not accept work: un-admit and surface
+                # the fencing rejection itself — never a retryable
+                # shed, the client must fail over to a live replica.
+                self._discard_admission(
+                    job, key, error="fenced: replica lease lost"
                 )
+                raise
             except Exception as e:  # noqa: BLE001 — disk weather
                 self._rollback_submit(job, key, e)  # raises
+            self._index_put(job)
         obs.instant(
             "job_transition", scope="p", id=job.id, to=JOB_QUEUED
         )
         return job, True
+
+    def _submit_event(self, job: Job) -> Dict[str, Any]:
+        """The journaled submission record. The replica/fencing fields
+        ride ONLY in replicated mode — a replica-less tier's records
+        stay byte-identical to every earlier round's."""
+        event: Dict[str, Any] = {
+            "e": "submit",
+            "id": job.id,
+            "seq": job.seq,
+            "key": job.key,
+            "spec": job.spec.to_record(),
+            "ts": job.submitted_unix,
+            "trace": job.trace_id,
+        }
+        if self._replica is not None:
+            event["replica"] = self._replica.replica_id
+            event["fence"] = self._replica.token()
+        return event
+
+    def _fence_check(self) -> None:
+        """Zombie fencing: raises ``FencedWriteError`` when this
+        replica's lease was lost or taken over — its late writes must
+        never merge into shared state. A replica-less tier is never
+        fenced."""
+        if self._replica is not None:
+            self._replica.check_fence()
+
+    def _index_put(self, job: Job) -> None:
+        """Mirror one job into the shared store index (``jobs/<id>``),
+        fenced on this replica's lease. Store weather degrades with a
+        warning — peers recover the same facts from journal adoption —
+        but a FENCING rejection is always loud."""
+        replica = self._replica
+        if replica is None or replica.degraded():
+            return
+        lease = replica.lease()
+        if lease is None:
+            return
+        record = self.record_of(job)
+        record["replica"] = replica.replica_id
+        record["fence"] = lease.token
+        try:
+            replica.store.put_fenced(
+                JOB_INDEX_PREFIX + job.id,
+                json.dumps(record, sort_keys=True).encode("utf-8"),
+                lease,
+            )
+        except StoreError as e:
+            print(
+                f"WARNING: shared job index write for {job.id} failed "
+                f"({e}); peers will see it at journal adoption instead.",
+                file=sys.stderr,
+            )
 
     def _rollback_submit(self, job: Job, key: str, exc: Exception) -> None:
         """Crash-safety contract: a job the journal cannot record must
@@ -304,13 +397,27 @@ class AnalysisJobTier:
             note_shed,
         )
 
+        self._discard_admission(job, key, error=f"journal write failed: {exc}")
+        note_shed("journal")
+        raise JournalUnavailableError(
+            f"analysis journal unavailable ({exc}); "
+            "submission not accepted",
+            5.0,
+        ) from exc
+
+    def _discard_admission(
+        self, job: Job, key: str, error: Optional[str] = None
+    ) -> None:
+        """Un-admit a job whose durable submit record never landed
+        (journal failure or fencing rejection): remove it from the
+        tables and the queue so no phantom consumes capacity."""
         with self._lock:
             self._jobs.pop(job.id, None)
             if self._by_key.get(key) == job.id:
                 self._by_key.pop(key, None)
             if self._queue.discard(job, job.spec.tenant):
                 if job.state == JOB_QUEUED:
-                    job.error = f"journal write failed: {exc}"
+                    job.error = error or "admission rolled back"
                     job.state = JOB_FAILED
                 # Only an un-run job gives its half-open probe slot
                 # back; if a worker already took it, that execution IS
@@ -318,12 +425,6 @@ class AnalysisJobTier:
                 # here too would admit a second concurrent probe past
                 # the bound.
                 self._breaker.release_probe()
-        note_shed("journal")
-        raise JournalUnavailableError(
-            f"analysis journal unavailable ({exc}); "
-            "submission not accepted",
-            5.0,
-        ) from exc
 
     def job(self, job_id: str) -> Optional[Job]:
         with self._lock:
@@ -400,7 +501,57 @@ class AnalysisJobTier:
         doc["breakers"] = {"analyze": self._breaker.state}
         delta_stats = getattr(self._engine, "delta_stats", None)
         doc["delta_cache"] = delta_stats() if delta_stats else None
+        # Outside the tier lock: LeaseManager.status() takes its own
+        # lock and lists peer leases off the store — tier._lock must
+        # never be held across store I/O.
+        doc["replica"] = (
+            self._replica.status() if self._replica is not None else None
+        )
         return doc
+
+    def replica_status(self) -> Optional[Dict[str, Any]]:
+        """The replica plane's identity/lease/store snapshot (None for
+        a replica-less tier) — the /statusz source. Lists peers off the
+        store; use :meth:`replica_health` where boundedness matters."""
+        if self._replica is None:
+            return None
+        return self._replica.status()
+
+    def replica_health(self) -> Optional[Dict[str, Any]]:
+        """Bounded replica bits for ``/healthz`` — in-memory lease
+        state only, NO store I/O (the exit-77 discipline: a health
+        probe must never hang on the very store whose weather it
+        reports)."""
+        if self._replica is None:
+            return None
+        return {
+            "replica_id": self._replica.replica_id,
+            "lease_state": self._replica.state(),
+            "store_reachable": not self._replica.degraded(),
+        }
+
+    def peer_job_record(self, job_id: str) -> Optional[Dict]:
+        """Look up a job unknown locally in the shared store index
+        (cross-replica ``GET /jobs/<id>``). None = nowhere; raises
+        :class:`StoreError` when the store is unreachable or this
+        process is degraded — the HTTP surface maps that to 503 +
+        Retry-After rather than lying with a 404."""
+        replica = self._replica
+        if replica is None or self._store_root is None:
+            return None
+        if replica.degraded():
+            raise StoreError(
+                "store degraded: cross-replica job lookup unavailable"
+            )
+        try:
+            blob = replica.store.get(JOB_INDEX_PREFIX + job_id)
+        except KeyError:
+            return None
+        try:
+            record = json.loads(blob.decode("utf-8"))
+        except ValueError:
+            return None
+        return record if isinstance(record, dict) else None
 
     def running_jobs(self) -> int:
         """Jobs currently in the RUNNING state (the /healthz busy-vs-
@@ -454,6 +605,7 @@ class AnalysisJobTier:
         thread (the worker body, exposed for deterministic tests and
         ``workers=0`` tiers). Returns False when nothing runnable was
         queued."""
+        self._maybe_adopt_peers()
         while True:
             job = self._queue.pop(timeout=timeout)
             if job is None:
@@ -621,6 +773,7 @@ class AnalysisJobTier:
 
     def _worker_loop(self) -> None:
         while not self._stop.is_set():
+            self._maybe_adopt_peers()
             job = self._queue.pop(timeout=0.25)
             if job is None:
                 continue
@@ -647,8 +800,6 @@ class AnalysisJobTier:
         # instead of restarting; the checkpointed route is single-
         # variantset only, so multi-set jobs simply re-run (still
         # bit-identical — the manifest is deterministic).
-        import os
-
         if job.spec.kind != "pca":
             # Read-scoring jobs have no Gramian to snapshot; replay
             # just re-runs them (per-pair results are deterministic).
@@ -664,16 +815,32 @@ class AnalysisJobTier:
             # ingest (snapshot digests are full-frame); these jobs are
             # the small delta-tier queries — replay just re-runs them.
             return None
-        return os.path.join(self._journal_dir, "ckpt", job.id)
+        # Replicated mode: checkpoints are SHARED (keyed by job id,
+        # which adoption preserves), so a survivor resumes a dead
+        # peer's Gramian from its last shard-group snapshot instead of
+        # from zero.
+        base = (
+            self._store_root
+            if self._store_root is not None
+            else self._journal_dir
+        )
+        return os.path.join(base, "ckpt", job.id)
 
     def _journal_append_safe(self, event: Dict) -> None:
         """Append a TRANSITION event (start/done/fail), degrading loudly
         on failure instead of killing the worker: losing a transition
         only costs resume WORK, never correctness — replay re-queues
         the job and re-execution is bit-identical. (Submit events are
-        different: those must land or the job is rolled back.)"""
+        different: those must land or the job is rolled back.)
+
+        The fence check runs OUTSIDE the swallowing try on purpose: a
+        ``FencedWriteError`` is a correctness verdict (this replica is
+        a zombie whose lease a peer took over), never disk weather —
+        degrading it to a warning would be exactly the torn merge
+        fencing exists to prevent."""
         if self._journal is None:
             return
+        self._fence_check()
         try:
             self._journal.append(event)
         except Exception as e:  # noqa: BLE001 — disk weather
@@ -763,6 +930,11 @@ class AnalysisJobTier:
     ) -> None:
         from spark_examples_tpu import obs
 
+        # Fence BEFORE any shared-visible mutation: a zombie's result
+        # must never reach the cache, the job table, or the journal —
+        # the adopting peer owns this job now and will produce the
+        # (bit-identical) result itself.
+        self._fence_check()
         with self._lock:
             if error is None:
                 # Result BEFORE state: the HTTP surface serializes
@@ -791,6 +963,7 @@ class AnalysisJobTier:
             self._prune_terminal_locked()
         # Disk I/O outside the tier lock (submit() reasoning).
         self._journal_append_safe(event)
+        self._index_put(job)
         obs.instant(
             "job_transition", scope="p", id=job.id, to=job.state
         )
@@ -906,3 +1079,198 @@ class AnalysisJobTier:
                     f"job(s), {done} done (cache warm), "
                     f"{len(requeue)} re-queued."
                 )
+
+    # -- peer failover ----------------------------------------------------------
+
+    def _maybe_adopt_peers(self) -> None:
+        """Throttled peer-lease scan (at most one per lease TTL):
+        workers call this on their dispatch path, so failover needs no
+        extra thread. The tier lock guards ONLY the throttle timestamp;
+        the scan itself does store I/O and must run unlocked. Never
+        raises — failover trouble must not kill the worker that would
+        perform the next failover."""
+        replica = self._replica
+        if replica is None or self._store_root is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if now - self._peer_scan_monotonic < replica.ttl_s:
+                return
+            self._peer_scan_monotonic = now
+        try:
+            self.adopt_expired_peers()
+        except Exception as e:  # noqa: BLE001 — failover must not wedge
+            print(
+                f"WARNING: peer adoption scan failed: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+
+    def adopt_expired_peers(self) -> int:
+        """Scan for peers whose lease expired, take each over by CAS
+        (the fencing-token bump that turns the dead peer into a fenced
+        zombie if it was merely paused), and re-queue its in-flight
+        jobs here in original submission order. Returns the number of
+        peers adopted.
+
+        At-least-once by construction: the ``adopted/<peer>`` marker is
+        written LAST, so a survivor dying mid-adoption leaves the peer
+        adoptable by the next scan — re-execution is bit-identical and
+        the merge below dedups, so a double adoption is safe."""
+        replica = self._replica
+        if (
+            replica is None
+            or self._store_root is None
+            or replica.degraded()
+        ):
+            return 0
+        adopted = 0
+        for peer in replica.expired_peers():
+            taken = replica.takeover(peer)
+            if taken is None:
+                # Raced by another survivor (its CAS won), or store
+                # weather — either way, not ours to adopt this round.
+                continue
+            self._adopt_peer(peer.name, taken)
+            adopted += 1
+        return adopted
+
+    def _adopt_peer(self, peer_name: str, taken: Lease) -> None:
+        from spark_examples_tpu import obs
+
+        assert self._replica is not None and self._store_root is not None
+        peer_dir = os.path.join(
+            self._store_root, "replicas", peer_name
+        )
+        with obs.span(
+            "job.adopt", peer=peer_name, fence=taken.token
+        ):
+            requeued = self._replay_foreign(peer_dir, peer_name)
+            # Marker BEFORE release: once the marker exists the peer is
+            # never re-adopted; until it exists a crash here re-runs
+            # the whole adoption. Fenced on OUR lease — a survivor that
+            # itself went zombie mid-adoption is rejected loudly.
+            self._replica.mark_adopted(
+                peer_name,
+                json.dumps(
+                    {
+                        "by": self._replica.replica_id,
+                        "fence": taken.token,
+                        "requeued": requeued,
+                    },
+                    sort_keys=True,
+                ).encode("utf-8"),
+            )
+            # Release the taken-over lease doc: the name disappears
+            # from scans, and the zombie stays fenced regardless (a
+            # MISSING lease doc fails check_fence just as a stale
+            # token does).
+            self._replica.finish_takeover(taken)
+
+    def _replay_foreign(self, directory: str, peer: str) -> int:
+        """Replay a dead peer's journal into THIS tier: terminal jobs
+        warm the result cache and job table, in-flight jobs re-queue in
+        the peer's submission order (with fresh LOCAL seqs — relative
+        order is preserved, and local admissions hold their own seqs).
+        Returns the number of jobs re-queued.
+
+        Disk discipline as everywhere in this tier: the peer journal is
+        read BEFORE the tier lock, adopted submit events are journaled
+        AFTER it."""
+        from spark_examples_tpu import obs
+
+        try:
+            events = list(JobJournal.replay_events(directory))
+        except Exception as e:  # noqa: BLE001 — a torn peer journal
+            print(
+                f"WARNING: adopting {peer}: journal unreadable "
+                f"({type(e).__name__}: {e}); its in-flight jobs are "
+                "lost to this survivor (clients resubmit).",
+                file=sys.stderr,
+            )
+            return 0
+        foreign: Dict[str, Job] = {}
+        order: List[str] = []
+        for e in events:
+            kind = e.get("e")
+            if kind == "submit":
+                try:
+                    spec = JobSpec.from_record(e["spec"])
+                except (KeyError, ValueError):
+                    continue
+                jid = str(e["id"])
+                foreign[jid] = Job(
+                    id=jid,
+                    spec=spec,
+                    key=str(
+                        e.get("key") or cohort_key(spec, self._base)
+                    ),
+                    seq=int(e.get("seq", 0)),
+                    submitted_unix=float(e.get("ts", 0.0)),
+                    # The peer's admission-minted trace id survives
+                    # adoption: the re-run emits onto the SAME timeline
+                    # its submitter is polling.
+                    trace_id=(
+                        str(e["trace"]) if e.get("trace") else None
+                    ),
+                )
+                order.append(jid)
+            elif kind in ("start", "done", "fail"):
+                job = foreign.get(str(e.get("id", "")))
+                if job is None:
+                    continue
+                if kind == "start":
+                    job.state = JOB_RUNNING
+                elif kind == "done":
+                    job.state = JOB_DONE
+                    job.result = [tuple(r) for r in e.get("rows", [])]
+                else:
+                    job.state = JOB_FAILED
+                    job.error = str(e.get("error", ""))
+        requeue: List[Job] = []
+        with self._lock:
+            for jid in order:
+                job = foreign[jid]
+                if jid in self._jobs:
+                    # Already known here — a prior partial adoption, or
+                    # the peer adopted it from US earlier. Keep ours.
+                    continue
+                if job.state in (JOB_DONE, JOB_FAILED):
+                    self._seq += 1
+                    job.seq = self._seq
+                    self._jobs[jid] = job
+                    if job.state == JOB_DONE and job.result is not None:
+                        self._cache.put(job.key, jid, job.result)
+                    continue
+                if self._by_key.get(job.key) is not None:
+                    # An identical cohort is already active here; its
+                    # result will serve the peer's submitter from the
+                    # cache (same key → bit-identical rows).
+                    continue
+                self._seq += 1
+                job.seq = self._seq
+                job.state = JOB_QUEUED
+                self._jobs[jid] = job
+                self._by_key[job.key] = jid
+                # Bypass shed checks: the dead peer already admitted
+                # these — failover must not drop admitted work.
+                self._queue.readmit(
+                    job, job.spec.tenant, job.spec.priority, job.seq
+                )
+                requeue.append(job)
+            self._prune_terminal_locked()
+        # The adopted submissions enter THIS replica's journal so a
+        # crash here resumes them yet again (transition-grade
+        # durability: the shared journal on the dead peer still holds
+        # them until its marker lands).
+        for job in requeue:
+            self._journal_append_safe(self._submit_event(job))
+            obs.instant(
+                "job_transition", scope="p", id=job.id, to=JOB_QUEUED
+            )
+        if requeue:
+            print(
+                f"Adopted {len(requeue)} in-flight job(s) from "
+                f"expired replica {peer}."
+            )
+        return len(requeue)

@@ -725,6 +725,50 @@ def add_analyze_flags(p: argparse.ArgumentParser) -> None:
         "back to cold on any cache doubt (docs/OPERATIONS.md §4c). "
         "0 disables the delta tier",
     )
+    from spark_examples_tpu.serving.replica import (
+        DEFAULT_HEARTBEAT_S,
+        DEFAULT_LEASE_TTL_S,
+    )
+
+    p.add_argument(
+        "--store-dir",
+        default=None,
+        help="Shared durable-store directory for replicated serving: "
+        "N serve-cohort replicas pointed at the same directory "
+        "coordinate through lease-owned jobs (per-replica journals, a "
+        "fenced shared job index, shared Gramian checkpoints and delta "
+        "write-through), so killing any replica mid-job lets a "
+        "survivor resume it bit-identically (docs/OPERATIONS.md "
+        "multi-replica runbook). Unset = single-replica local mode; "
+        "an unreachable store degrades to the same, never crashes",
+    )
+    p.add_argument(
+        "--replica-id",
+        default=None,
+        help="Stable identity of this replica in the shared store "
+        "(its lease name and journal subdirectory); default is a "
+        "generated host-pid-suffix id. Reusing a dead replica's id "
+        "resumes its journal; two LIVE replicas must never share one",
+    )
+    p.add_argument(
+        "--replica-lease-ttl",
+        type=float,
+        default=DEFAULT_LEASE_TTL_S,
+        help="Replica lease time-to-live in seconds (> 0): how stale a "
+        "peer's heartbeat must be before survivors declare it dead and "
+        "adopt its in-flight jobs. Lower = faster failover, higher = "
+        "more tolerance for GC/IO pauses before a live replica is "
+        "zombied (its late writes are then fenced, not merged)",
+    )
+    p.add_argument(
+        "--replica-heartbeat",
+        type=float,
+        default=DEFAULT_HEARTBEAT_S,
+        help="Replica lease renewal interval in seconds (0 < value < "
+        "ttl; ttl/5 to ttl/3 is a sane band): each renewal re-proves "
+        "ownership under the fencing token and recovers the store "
+        "after degraded spells",
+    )
     p.add_argument(
         "--gang-max-samples",
         type=int,

@@ -1032,3 +1032,116 @@ def test_producer_failure_is_synced_not_one_sided(tmp_path, scenario):
     for pid in (0, 1):
         result = json.loads((tmp_path / f"result.json.{pid}").read_text())
         assert result["ok"], result
+
+
+_PEER_DEATH_WORKER = textwrap.dedent(
+    """
+    import json, os, signal, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from spark_examples_tpu.parallel.distributed import initialize_from_env
+    assert initialize_from_env()
+    from spark_examples_tpu.parallel.podstream import (
+        PodSlot,
+        PodWindowExchange,
+        SlotPipeline,
+    )
+
+    pid = jax.process_index()
+    world = jax.process_count()
+    KILL_STEP = 3
+    victim = world - 1  # never the coordinator (process 0)
+
+    # Short deadline so a regression (survivor hanging out the receive
+    # instead of converting peer death) fails the harness timeout, not
+    # the 30-minute production deadline.
+    ex = PodWindowExchange.open(timeout_s=30.0)
+    assert ex is not None
+
+    state = {"step": 0}
+
+    def produce():
+        step = state["step"]
+        state["step"] += 1
+        header = np.array([0, 0, 0, 0, 0, step, 0], np.int64)
+        if pid == victim and step == KILL_STEP:
+            # Die MID-exchange: header posted, confirm never follows —
+            # survivors that already drained the buffered header must
+            # still converge on the same slot via the confirm phase.
+            ex.post_header(step, header)
+            os.kill(os.getpid(), signal.SIGKILL)
+        ex.post_header(step, header)
+        gathered = ex.gather_headers(step, 7)
+        failed = [
+            i for i, row in enumerate(gathered) if int(row[0]) == -2
+        ]
+        if failed:
+            raise RuntimeError(
+                f"peers failed: {failed} at step {step}"
+            )
+        ex.post_confirm(step, True)
+        confirms = ex.gather_confirms(step)
+        bad = [i for i, v in enumerate(confirms) if int(v) == -2]
+        if bad:
+            raise RuntimeError(f"peers failed: {bad} at step {step}")
+        return PodSlot(
+            step=step,
+            route="scatter",
+            gathered=None,
+            local=None,
+            nnz=0,
+            variants=0,
+            windows=1,
+        )
+
+    completed = []
+    err = None
+    try:
+        for slot in SlotPipeline(produce, depth=2):
+            completed.append(slot.step)
+    except RuntimeError as e:
+        err = str(e)
+    ok = (
+        err is not None
+        and f"at step {KILL_STEP}" in err
+        and str(victim) in err
+        and completed == list(range(KILL_STEP))
+    )
+    with open(sys.argv[1] + f".{pid}", "w") as f:
+        json.dump({"ok": ok, "err": err, "completed": completed}, f)
+    # _exit, not sys.exit: the atexit jax.distributed.shutdown would
+    # barrier on the DEAD peer until the coordination-service heartbeat
+    # aborts this process — the exact hang the conversion just avoided.
+    os._exit(0 if ok else 3)
+    """
+)
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_pod_peer_death_fails_everywhere_same_slot(tmp_path, world):
+    """Kill -9 one pod process mid-exchange (header posted, confirm
+    never follows): every SURVIVOR must raise the synchronized −2
+    producer-failure shape at the SAME slot — the kill step — instead
+    of hanging out the receive deadline one phase apart. Exercises the
+    peer-death conversion (EOF/ECONNRESET → synthesized −2 rows) and
+    the mesh-teardown cascade that propagates detection between
+    survivors."""
+    script = tmp_path / "worker.py"
+    script.write_text(_PEER_DEATH_WORKER)
+    out_file = tmp_path / "result.json"
+    logs = _run_workers(
+        script,
+        [out_file],
+        n=world,
+        timeout=120,
+        expected_rcs=[0] * (world - 1) + [-9],
+    )
+    for pid in range(world - 1):
+        result = json.loads((tmp_path / f"result.json.{pid}").read_text())
+        assert result["ok"], (result, logs[pid][-1500:])
+        # All survivors agree: steps before the kill completed, the
+        # raise landed exactly at the victim's slot.
+        assert result["completed"] == [0, 1, 2]

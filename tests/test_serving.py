@@ -320,6 +320,74 @@ class TestJobJournal:
         j.close()
         assert name not in watchdog._flush_hooks
 
+    def test_mixed_version_journal_replays_byte_compatibly(
+        self, tmp_path, served_source
+    ):
+        """One journal directory accumulated across server generations
+        — pre-round-14 submits (no trace field), trace-carrying
+        submits, and replicated-mode submits (replica identity +
+        fencing token) — replays as ONE history: new fields survive
+        verbatim, old records gain nothing, and the rebuilt tier state
+        (terminal results, requeue order, restored trace ids) is what
+        a single-version journal of the same events produces."""
+        src, base, _ = served_source
+        d = str(tmp_path / "j")
+        j = JobJournal(d)
+        # Generation 1: the original event shape — no trace field.
+        j.append(
+            {"e": "submit", "id": "old", "seq": 1, "key": "k-old",
+             "spec": {"tenant": "t"}, "ts": 1.0}
+        )
+        j.append({"e": "start", "id": "old"})
+        j.append(
+            {"e": "done", "id": "old",
+             "rows": [["s", 0.5, -0.25, "d"]]}
+        )
+        # Generation 2 (round 14+): the admission-minted trace id.
+        j.append(
+            {"e": "submit", "id": "traced", "seq": 2, "key": "k-tr",
+             "spec": {"tenant": "t"}, "ts": 2.0, "trace": "t-abc"}
+        )
+        j.append({"e": "start", "id": "traced"})
+        # Generation 3 (replicated serving): replica + fence ride the
+        # submit; a non-replica reader must ignore them, not die.
+        j.append(
+            {"e": "submit", "id": "fenced", "seq": 3, "key": "k-fe",
+             "spec": {"tenant": "t"}, "ts": 3.0, "trace": "t-def",
+             "replica": "r-host-1-abc123", "fence": 7}
+        )
+        j.close()
+
+        events = list(JobJournal.replay_events(d))
+        assert [e["e"] for e in events] == [
+            "submit", "start", "done", "submit", "start", "submit",
+        ]
+        # New fields replay verbatim; old records gained nothing.
+        assert events[5]["replica"] == "r-host-1-abc123"
+        assert events[5]["fence"] == 7
+        assert "trace" not in events[0] and "replica" not in events[0]
+
+        tier = AnalysisJobTier(
+            AnalysisEngine(src), base, workers=0, journal_dir=d
+        )
+        try:
+            by_id = {job.id: job for job in tier.jobs()}
+            assert by_id["old"].state == "done"
+            assert by_id["old"].result == [("s", 0.5, -0.25, "d")]
+            assert by_id["old"].trace_id is None
+            # In-flight jobs of every generation re-queue in original
+            # submission order with their trace ids restored.
+            assert by_id["traced"].state == "queued"
+            assert by_id["traced"].trace_id == "t-abc"
+            assert by_id["fenced"].state == "queued"
+            assert by_id["fenced"].trace_id == "t-def"
+            assert [job.id for job in tier.jobs()] == [
+                "old", "traced", "fenced",
+            ]
+            assert tier.queue_depth() == 2
+        finally:
+            tier.close()
+
 
 class TestTierExecution:
     def test_job_matches_batch_driver_bit_identical(self, served_source):
