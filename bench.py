@@ -451,6 +451,7 @@ def scale_out_sweep():
             f"({sweep[-1]['nnz_per_sec']:.0f} nnz/s)"
         )
     pod = _pod_sparse_leg(carriers, block_v)
+    sketch = _sketch_scale_leg(carriers, block_v, mesh)
     largest = sweep[-1]
     print(
         _json.dumps(
@@ -475,6 +476,7 @@ def scale_out_sweep():
                 },
                 "sweep": sweep,
                 "pod": pod,
+                "sketch": sketch,
                 "workload": "rare-variant CSR cohort, fixed "
                 "carriers-per-variant (density falls as 1/N — the "
                 "biobank AF shape)",
@@ -482,6 +484,116 @@ def scale_out_sweep():
             }
         )
     )
+
+
+def _sketch_scale_leg(carriers: int, block_v: int, mesh):
+    """The Gramian-free leg of the scale-out sweep: ``--pca-mode
+    sketch`` at N past where EVERY exact path refuses. The sparse
+    accumulator holds an f32 N×N on this host (all devices of a
+    single-process mesh are addressable here), so its 4 GiB footprint
+    bound fires above N = 32768; the sketch panel is O(N·(k+p)) and
+    keeps going. BENCH_SCALE_SKETCH_NS picks the cohort sizes (default
+    ``1048576`` — the 2^20 biobank point; empty string disables),
+    BENCH_SCALE_SKETCH_K the component count (default 10). Emits
+    ``sketch_samples_per_sec`` per N plus the documented panel bound
+    (``ops.sketch.sketch_host_bytes``), the exact path's refused
+    footprint, and ``ru_maxrss`` provenance — the measured proof that
+    the refusal boundary was actually crossed, not simulated. Timing
+    barrier: ``sketch_eig`` returns host ndarrays (coords readback IS
+    the sync point)."""
+    import resource
+
+    from spark_examples_tpu.arrays.blocks import csr_windows
+    from spark_examples_tpu.ops.pcoa import randomized_panel_width
+    from spark_examples_tpu.ops.sketch import sketch_eig
+    from spark_examples_tpu.parallel.sharded import sharded_sketch_panel
+
+    ns = [
+        int(s)
+        for s in os.environ.get(
+            "BENCH_SCALE_SKETCH_NS", "1048576"
+        ).split(",")
+        if s.strip()
+    ]
+    if not ns:
+        return {"skipped": "BENCH_SCALE_SKETCH_NS empty"}
+    k = int(os.environ.get("BENCH_SCALE_SKETCH_K", 10))
+    n_variants = int(os.environ.get("BENCH_SCALE_SKETCH_V", 2048))
+    power_iters = int(os.environ.get("BENCH_SCALE_SKETCH_POWER", 0))
+    repeat = int(os.environ.get("BENCH_SCALE_REPEAT", 2))
+    bound = 4 << 30  # models.pca max_host_bytes / SKETCH_AUTO_G_BYTES
+    sweep = []
+    for i, n in enumerate(ns):
+        rng = np.random.default_rng(1000 + i)
+        kc = min(carriers, n)
+        idx = np.empty(n_variants * kc, dtype=np.int64)
+        for v in range(n_variants):
+            idx[v * kc : (v + 1) * kc] = rng.choice(
+                n, size=kc, replace=False
+            )
+        offsets = np.arange(n_variants + 1, dtype=np.int64) * kc
+        nnz = int(offsets[-1])
+        panel_box = {}
+
+        def run(idx=idx, offsets=offsets, n=n, box=panel_box):
+            panel = sharded_sketch_panel(
+                lambda: csr_windows(iter([(idx, offsets)]), block_v),
+                n,
+                k,
+                mesh,
+                power_iters=power_iters,
+                seed=0,
+                block_variants=block_v,
+            )
+            box["panel"] = panel
+            coords, _vals = sketch_eig(panel, k)
+            assert coords.shape == (n, k)
+
+        _log(f"bench: scale-out sketch N={n} nnz={nnz} (warm) ...")
+        run()  # warm: compile + allocator
+        t = _best(run, repeat=repeat)
+        width = randomized_panel_width(n, k)
+        exact_g = 4 * n * n  # f32 N x N, all tiles on this host
+        sweep.append(
+            {
+                "n": n,
+                "k": k,
+                "panel_width": width,
+                "variants": n_variants,
+                "nnz": nnz,
+                "power_iters": power_iters,
+                "seconds": round(t, 4),
+                "samples_per_sec": round(n / t, 2),
+                "sketch_host_bytes": int(
+                    panel_box["panel"].host_peak_bytes
+                ),
+                "exact_host_g_bytes": exact_g,
+                "exact_refused": exact_g > bound,
+                "host_bytes_bound": bound,
+                "ru_maxrss_bytes": resource.getrusage(
+                    resource.RUSAGE_SELF
+                ).ru_maxrss
+                * 1024,
+            }
+        )
+        _log(
+            f"bench: scale-out sketch N={n} {t:.3f}s "
+            f"({sweep[-1]['samples_per_sec']:.0f} samples/s, "
+            f"exact_refused={sweep[-1]['exact_refused']})"
+        )
+    largest = sweep[-1]
+    return {
+        "metric": "sketch_samples_per_sec",
+        "value": largest["samples_per_sec"],
+        "unit": "samples/s",
+        "sweep": sweep,
+        "path": "parallel.sharded.sharded_sketch_panel + "
+        "ops.sketch.sketch_eig (cli pca --pca-mode sketch)",
+        "workload": "rare-variant CSR cohort, fixed "
+        "carriers-per-variant; panel footprint O(N*(k+p)) where the "
+        "exact N^2 accumulator refuses past N=32768",
+        "timing": "host readback of coords via sketch_eig",
+    }
 
 
 _POD_SPARSE_BENCH_WORKER = '''

@@ -83,6 +83,19 @@ _SPARSE_SPANS = {
                                   # gang pull + exchanges + payload)
 }
 
+# Gramian-free sketch engine span contract (ops/sketch.py + the mesh
+# half in parallel/sharded.py): every `gramian.sketch.<sub>` span must
+# be one of these — the million-sample-trajectory captures attribute
+# streamed-panel accumulation vs the TSQR/Nyström finish from exactly
+# this set.
+_SKETCH_SPANS = {
+    "gramian.sketch.accumulate",  # one whole panel pass over the
+                                  # window stream (sketch_pass in args)
+    "gramian.sketch.window",      # one CSR window applied to the panel
+                                  # (route=scatter|dense)
+    "gramian.sketch.finish",      # the TSQR/Nyström eigensolve
+}
+
 # Read-level kernel pipeline span contract (models/pairhmm.py): every
 # `pairhmm.<sub>` span must be one of these — the reads-workload
 # capture windows attribute host-prep vs device-forward time from
@@ -216,6 +229,15 @@ def validate_trace(path: str) -> List[str]:
                 f"{sorted(_SPARSE_SPANS)})"
             )
         elif (
+            ev["name"].startswith("gramian.sketch.")
+            and ev["name"] not in _SKETCH_SPANS
+        ):
+            errors.append(
+                f"{where}: unknown sketch-engine span "
+                f"{ev['name']!r} (expected one of "
+                f"{sorted(_SKETCH_SPANS)})"
+            )
+        elif (
             ev["name"].startswith("pairhmm.")
             and ev["name"] not in _PAIRHMM_SPANS
         ):
@@ -278,6 +300,8 @@ _LABELED_COUNTERS = {
                                           # degraded/recovered/released/
                                           # rejected_write
     "serving_shed_total": "reason",       # queue_full/quota
+    "sketch_windows_total": "route",      # scatter/dense per sketch-
+                                          # panel window
     "sparse_gramian_windows_total": "route",  # scatter/dense per window
     "sparse_pod_coalesced_windows_total": "mode",  # gang/solo per step
     "sparse_pod_sync_total": "outcome",   # synced/drained/producer-error/

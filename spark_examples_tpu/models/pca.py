@@ -42,9 +42,9 @@ from spark_examples_tpu.ops import (
     mllib_principal_components_reference,
     pcoa,
 )
-from spark_examples_tpu.utils.config import PcaConfig
+from spark_examples_tpu.utils.config import PCA_MODES, PcaConfig
 
-__all__ = ["VariantsPcaDriver"]
+__all__ = ["PCA_MODES", "VariantsPcaDriver"]
 
 
 def _contig_runs_unique(shards) -> bool:
@@ -98,13 +98,16 @@ class VariantsPcaDriver:
                 f"--ingest-workers must be >= 1 (or 0 = auto), got "
                 f"{conf.ingest_workers}"
             )
-        if conf.pca_mode not in ("auto", "fused", "stream", "sparse"):
+        if conf.pca_mode not in PCA_MODES:
             # argparse choices only guard the CLI; a programmatic typo
             # ('streaming', 'Stream') would otherwise silently fall
-            # through to the auto gate.
+            # through to the auto gate. The allowed set and this error
+            # message both derive from the ONE registry
+            # (utils.config.PCA_MODES) — a sync test pins them.
+            allowed = ", ".join(repr(m) for m in PCA_MODES)
             raise ValueError(
-                f"pca_mode must be 'auto', 'fused', 'stream', or "
-                f"'sparse'; got {conf.pca_mode!r}"
+                f"pca_mode must be one of {allowed}; got "
+                f"{conf.pca_mode!r}"
             )
         if conf.pca_mode == "sparse" and conf.checkpoint_dir:
             # Snapshot digests cut at manifest positions; the sparse
@@ -114,6 +117,32 @@ class VariantsPcaDriver:
                 "--pca-mode sparse does not compose with checkpointed "
                 "ingest yet; drop --checkpoint-dir or use --pca-mode "
                 "auto/stream"
+            )
+        if conf.pca_mode == "sketch" and conf.checkpoint_dir:
+            # The sketch panel has no snapshot grid (and a resumed
+            # partial panel would silently double-count windows).
+            raise ValueError(
+                "--pca-mode sketch does not compose with checkpointed "
+                "ingest; drop --checkpoint-dir or use --pca-mode auto"
+            )
+        if conf.pca_mode == "sketch" and conf.precise:
+            # --precise is definitionally the host-f64 EXACT route; the
+            # sketch engine is approximate by contract. Refuse the
+            # contradiction rather than silently demote either flag.
+            raise ValueError(
+                "--pca-mode sketch is the randomized approximate "
+                "engine and cannot honor --precise; drop one"
+            )
+        if getattr(conf, "sketch_oversample", 8) < 1:
+            raise ValueError(
+                "--sketch-oversample must be >= 1 (the panel needs a "
+                "value past k for the spectral-gap check), got "
+                f"{conf.sketch_oversample}"
+            )
+        if getattr(conf, "sketch_power_iters", 0) < 0:
+            raise ValueError(
+                "--sketch-power-iters must be >= 0, got "
+                f"{conf.sketch_power_iters}"
             )
         if getattr(conf, "sparse_density_threshold", 0.02) < 0:
             raise ValueError(
@@ -878,6 +907,91 @@ class VariantsPcaDriver:
                 total += rows * cols * itemsize
             return total
         return n * n * itemsize
+
+    # The auto-sketch trigger: the same per-host budget the streaming-
+    # sparse footprint refusal enforces (get_similarity_matrix_stream's
+    # max_host_bytes default). Auto stays conservative — every exact
+    # path wins below this bound; only where N² would REFUSE does the
+    # approximate engine take over.
+    SKETCH_AUTO_G_BYTES = 4 << 30
+
+    def sketch_selected(self) -> bool:
+        """Public probe for serving callers: will :meth:`ingest_gramian`
+        return a Gramian-free :class:`~spark_examples_tpu.ops.sketch.
+        SketchPanel` instead of an (N, N) array? (The delta/gang tiers
+        must route around such jobs — there is no G to cache, correct,
+        or stack.)"""
+        return self._sketch_selected()
+
+    def _sketch_selected(self) -> bool:
+        """Route ingest through the Gramian-free sketch engine?
+
+        ``--pca-mode sketch`` forces it; ``auto`` selects it ONLY where
+        the exact paths are architecturally refused — an uncheckpointed
+        run whose per-host Gramian tile footprint
+        (:meth:`_sparse_host_g_bytes`, the same bound the streaming
+        footprint refusal enforces) exceeds the 4 GiB budget. Below
+        that bound every exact tier is both feasible and preferable
+        (bit-exact, no tolerance contract), so auto never trades
+        exactness for nothing.
+        """
+        mode = self.conf.pca_mode
+        if mode == "sketch":
+            return True
+        if mode != "auto":
+            return False
+        return (
+            not self.conf.checkpoint_dir
+            and self._sparse_host_g_bytes() > self.SKETCH_AUTO_G_BYTES
+        )
+
+    def _sketch_panel(self):
+        """Sketch-engine ingest: stream cohort-frame CSR carrier
+        windows into an (N, k+p) randomized panel — the ``--pca-mode
+        sketch`` replacement for every N×N accumulation path
+        (ops/sketch.py has the math and the tolerance contract).
+        ``windows_factory`` returns a FRESH stream per call because
+        each ``--sketch-power-iters`` pass re-streams the cohort."""
+        from spark_examples_tpu.utils import softcancel
+
+        def windows_factory():
+            for window in self._cohort_windows():
+                softcancel.check("sketch panel window boundary")
+                yield window
+
+        with self._watchdog().armed("sketch ingest+panel"):
+            if self.mesh is not None:
+                from spark_examples_tpu.parallel.sharded import (
+                    sharded_sketch_panel,
+                )
+
+                return sharded_sketch_panel(
+                    windows_factory,
+                    self.cohort.size,
+                    self.conf.num_pc,
+                    self.mesh,
+                    oversample=self.conf.sketch_oversample,
+                    power_iters=self.conf.sketch_power_iters,
+                    seed=self.conf.sketch_seed,
+                    density_threshold=self.conf.sparse_density_threshold,
+                    block_variants=self.conf.block_variants,
+                    pipeline_depth=self.conf.pod_pipeline_depth,
+                    coalesce_variants=self.conf.pod_coalesce_variants,
+                )
+            from spark_examples_tpu.ops.sketch import (
+                sketch_panel_blockwise,
+            )
+
+            return sketch_panel_blockwise(
+                windows_factory,
+                self.cohort.size,
+                self.conf.num_pc,
+                oversample=self.conf.sketch_oversample,
+                power_iters=self.conf.sketch_power_iters,
+                seed=self.conf.sketch_seed,
+                density_threshold=self.conf.sparse_density_threshold,
+                block_variants=self.conf.block_variants,
+            )
 
     def _windows_to_gramian(self, windows):
         """CSR carrier windows → finished G via the sparse-aware engine
@@ -1723,6 +1837,24 @@ class VariantsPcaDriver:
     def _compute_pca(self, g, timer=None) -> List[Tuple[str, float, float]]:
         import jax.numpy as jnp
 
+        from spark_examples_tpu.ops.sketch import SketchPanel
+
+        if isinstance(g, SketchPanel):
+            # Gramian-free finish: row sums rode the panel's companion
+            # column (integer-exact in f32), so the parity print
+            # survives without G; the eigensolve is the Nyström/TSQR
+            # finish with the same gap check and sign convention as
+            # every exact tier.
+            from spark_examples_tpu.ops.sketch import sketch_eig
+
+            nonzero = int((np.asarray(g.row_sums) > 0).sum())
+            print(
+                f"Non zero rows in matrix: {nonzero} / "
+                f"{self.cohort.size}."
+            )  # VariantsPca.scala:207-208
+            coords, _ = sketch_eig(g, self.conf.num_pc, timer=timer)
+            return self._emit_tuples(coords)
+
         if self._pca_fused_eligible(g):
             from spark_examples_tpu.ops.fused import fused_finish
 
@@ -1946,6 +2078,12 @@ class VariantsPcaDriver:
             or self.conf.elastic_checkpoint
         ):
             return self.get_similarity_matrix_checkpointed()
+        if self._sketch_selected():
+            # Gramian-free: the return value is a SketchPanel, not an
+            # (N, N) array — compute_pca dispatches on it, and serving
+            # callers that cache/delta G must route around it
+            # (engine.run's sketch branch).
+            return self._sketch_panel()
         if self._sparse_selected():
             return self._gramian_sparse()
         if self._fused_csr_possible():

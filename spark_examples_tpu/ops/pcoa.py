@@ -29,6 +29,8 @@ import numpy as np
 from spark_examples_tpu.ops.centering import double_center
 
 __all__ = [
+    "DEFAULT_RANDOMIZED_OVERSAMPLE",
+    "DEFAULT_SKETCH_POWER_ITERS",
     "SpectralGapWarning",
     "check_spectral_gap",
     "randomized_panel_width",
@@ -39,14 +41,33 @@ __all__ = [
     "normalize_eigvec_signs",
 ]
 
+# The ONE oversampling default every randomized top-k consumer derives
+# from: the exact sharded finish (parallel.sharded.topk_eig_randomized)
+# and the Gramian-free sketch engine (ops/sketch.py, --sketch-oversample)
+# both resolve their panel width through randomized_panel_width with
+# this value — a drifted copy in either caller would silently change
+# which Ritz pairs exist for the gap check.
+DEFAULT_RANDOMIZED_OVERSAMPLE = 8
 
-def randomized_panel_width(n: int, k: int, oversample: int) -> int:
+# Extra full streamed passes the sketch engine runs with Ω ← orth(Y)
+# between them (--sketch-power-iters). 0 = ONE pass over the windows —
+# the cold-stream overlap discipline (arxiv 1302.4332); the tolerance
+# goldens use ≥ 2 where the approximation regime needs them.
+DEFAULT_SKETCH_POWER_ITERS = 0
+
+
+def randomized_panel_width(
+    n: int, k: int, oversample: int = DEFAULT_RANDOMIZED_OVERSAMPLE
+) -> int:
     """Panel width p for a randomized top-k eigensolve — the ONE place
     the k+1-values calling convention lives.
 
     Every consumer of randomized subspace iteration
     (:func:`spark_examples_tpu.parallel.sharded.topk_eig_randomized`,
-    and through it the sharded finish) needs the oversampled panel to
+    and through it the sharded finish — and the Gramian-free sketch
+    engine of :mod:`spark_examples_tpu.ops.sketch`, whose Ω panel and
+    Nyström core are sized by exactly this width) needs the
+    oversampled panel to
     carry AT LEAST ``min(k+1, n)`` Ritz pairs: ``k`` for the returned
     components plus one past the gap for :func:`check_spectral_gap`
     (which silently returns when no value past index k−1 exists — the

@@ -38,6 +38,7 @@ from spark_examples_tpu.ops.gramian import (
     unpack_indicator_block,
 )
 from spark_examples_tpu.ops.pcoa import (
+    DEFAULT_RANDOMIZED_OVERSAMPLE,
     SpectralGapWarning,
     check_spectral_gap,
     normalize_eigvec_signs,
@@ -55,6 +56,9 @@ __all__ = [
     "sharded_gramian_blockwise",
     "sharded_gramian_blockwise_global",
     "sharded_pcoa",
+    "sharded_sketch_finish",
+    "sharded_sketch_panel",
+    "sketch_tsqr",
     "sparse_sharded_gramian_blockwise",
     "topk_eig_randomized",
 ]
@@ -1579,7 +1583,7 @@ def sparse_sharded_gramian_blockwise(
 def topk_eig_randomized(
     c,
     k: int,
-    oversample: int = 8,
+    oversample: int = DEFAULT_RANDOMIZED_OVERSAMPLE,
     iters: int = 30,
     seed: int = 0,
     mesh: Mesh = None,
@@ -1773,3 +1777,383 @@ def sharded_pcoa(
             lambda kk: principal_components(c, kk), k, n, timer=timer
         )
     return topk_eig_randomized(c, k, mesh=mesh, timer=timer, tol=eig_tol)
+
+
+# -- Gramian-free sketch panels (ops/sketch.py's mesh half) ------------------
+
+
+def _replicated_np(a) -> np.ndarray:
+    """Host copy of a (possibly process-spanning) fully-replicated
+    array. Every process holds the whole value under P(None, ...), so
+    the local shard IS the global array — no collective needed."""
+    if getattr(a, "is_fully_addressable", True):
+        return np.asarray(a)
+    return np.asarray(a.addressable_shards[0].data)
+
+
+@functools.lru_cache(maxsize=None)
+def _sketch_pod_dense_kernel(mesh: Mesh):
+    """The dense-route pod step for the SKETCH panel as one explicit
+    shard_map program: each device unpacks only ITS packed variant
+    columns (the same variant-axis-over-everything payload layout the
+    Gramian pod step ships), computes its local
+    ``X_loc · (X_locᵀ · Ω̃)`` contribution, and one psum over every
+    mesh axis replicates the window's full update — no (N, V) unpack
+    broadcast anywhere (the GSPMD-rematerialization lesson of the
+    Gramian's `_tile_dense_pod`)."""
+    all_axes = tuple(mesh.axis_names)
+
+    def _step(y_loc, xp_loc, om_loc):
+        xb = unpack_indicator_block(
+            xp_loc, 8 * xp_loc.shape[1]
+        ).astype(y_loc.dtype)
+        contrib = xb @ (xb.T @ om_loc)
+        return y_loc + jax.lax.psum(contrib, all_axes)
+
+    return jax.jit(
+        _shard_map(
+            _step,
+            mesh=mesh,
+            in_specs=(P(None, None), P(None, all_axes), P(None, None)),
+            out_specs=P(None, None),
+            check_vma=False,
+        ),
+        donate_argnums=(0,),
+    )
+
+
+def sketch_tsqr(y, mesh: Mesh):
+    """Tall-skinny QR of a mesh-resident (n_padded, l) panel — the
+    sketch finish's pod-scale factorization (ROADMAP item 2's
+    "TSQR + small eig" half).
+
+    Classic two-level TSQR under ``shard_map`` over EVERY mesh axis
+    flattened: per-device thin QR of the local row block, an
+    ``all_gather`` of the (l, l) R factors, one replicated QR of the
+    stacked (D·l, l) ladder, and each device composes its Q block with
+    its slice of the second-level Q. Returns ``(q, r)``: q row-sharded
+    over the flattened device axis, r replicated. Requires
+    ``n_padded / device_count ≥ l`` (callers fall back to a host QR
+    below that — the panel is tiny there by definition)."""
+    axes = tuple(mesh.axis_names)
+    n_padded, l = int(y.shape[0]), int(y.shape[1])
+    flat = P(axes, None)
+    flat_sharding = NamedSharding(mesh, flat)
+
+    def _local(y_loc):
+        q1, r1 = jnp.linalg.qr(y_loc)
+        rs = jax.lax.all_gather(r1, axes, axis=0, tiled=True)
+        q2, r = jnp.linalg.qr(rs)
+        # Flattened device index composed per-axis: tuple-valued
+        # axis_index is newer than the jax floor this tree supports.
+        i = jnp.int32(0)
+        for name in axes:
+            i = i * mesh.shape[name] + jax.lax.axis_index(name)
+        q2_i = jax.lax.dynamic_slice(q2, (i * l, 0), (l, l))
+        return q1 @ q2_i, r
+
+    fn = jax.jit(
+        _shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=flat,
+            out_specs=(flat, P(None, None)),
+            check_vma=False,
+        )
+    )
+    y_flat = jax.jit(lambda a: a, out_shardings=flat_sharding)(y)
+    return fn(y_flat)
+
+
+def sharded_sketch_panel(
+    windows_factory,
+    n_samples: int,
+    k: int,
+    mesh: Mesh,
+    oversample=None,
+    power_iters=None,
+    seed: int = 0,
+    density_threshold=None,
+    block_variants=None,
+    pipeline_depth: int = 2,
+    coalesce_variants=None,
+):
+    """Stream CSR carrier windows into a mesh-replicated (N, k+p)
+    sketch panel — the ``--pca-mode sketch`` twin of
+    :func:`sparse_sharded_gramian_blockwise` that never materializes
+    an N×N tile anywhere (ROADMAP item 2's million-sample row).
+
+    The panel is O(N·(k+p)) f32, so unlike G it REPLICATES over the
+    mesh (P(None, None)); what the mesh buys is the window machinery —
+    and the TSQR finish. Topologies:
+
+    - single-controller mesh: host window loop, full sample range (the
+      sketch updates every row per window, so the Gramian path's
+      sample-range restriction must NOT apply);
+    - process-spanning pod: the per-step carrier-allgather protocol
+      (:func:`_synced_carrier_stream`) unchanged — headers, fencing,
+      route sync, coalesced gangs, and collective-check digests all
+      extend to sketch steps for free; scatter payloads feed the same
+      OOB-drop panel scatter, dense payloads the explicit
+      psum program (:func:`_sketch_pod_dense_kernel`);
+    - host-local mesh on a multi-controller run: each host accumulates
+      its manifest slice's partial panel and the partials merge over
+      DCN (the dense tiers' allreduce shape, but on (N, l) panels).
+
+    ``windows_factory`` returns a fresh iterator per call — each
+    ``--sketch-power-iters`` pass re-streams the cohort with
+    Ω ← orth(Y). Returns an :class:`~spark_examples_tpu.ops.sketch.
+    SketchPanel` with host f64 panels (n_padded rows) and ``mesh`` set
+    so the finish routes through :func:`sharded_sketch_finish`.
+    """
+    from spark_examples_tpu import obs
+    from spark_examples_tpu.arrays.blocks import (
+        DEFAULT_BLOCK_VARIANTS,
+        _check_indices,
+        _densify_window,
+        round_up_multiple,
+    )
+    from spark_examples_tpu.ops.pcoa import (
+        DEFAULT_SKETCH_POWER_ITERS,
+        randomized_panel_width,
+    )
+    from spark_examples_tpu.ops.sketch import (
+        _note_sketch_window,
+        _sketch_dense_update,
+        _sketch_scatter_update,
+        gaussian_test_matrix,
+        sketch_host_bytes,
+    )
+    from spark_examples_tpu.ops.sparse import (
+        DEFAULT_SPARSE_DENSITY_THRESHOLD,
+        _pad_rows_for_scan,
+        dense_panel_width,
+        padded_carrier_matrix,
+        window_route,
+    )
+
+    if density_threshold is None:
+        density_threshold = DEFAULT_SPARSE_DENSITY_THRESHOLD
+    if oversample is None:
+        oversample = DEFAULT_RANDOMIZED_OVERSAMPLE
+    if power_iters is None:
+        power_iters = DEFAULT_SKETCH_POWER_ITERS
+    width = block_variants or DEFAULT_BLOCK_VARIANTS
+    l = randomized_panel_width(n_samples, k, oversample)
+    all_axes = tuple(mesh.axis_names)
+    n_padded = round_up_multiple(
+        n_samples, _axis_product(mesh, P(all_axes))
+    )
+    spans = _mesh_spans_processes(mesh)
+    rep = NamedSharding(mesh, P(None, None))
+    omega_cur = gaussian_test_matrix(n_samples, l, seed)
+    row_sums = np.zeros(n_samples, dtype=np.float64)
+    y_host = None
+    for p in range(power_iters + 1):
+        first = p == 0
+        aug = _sketch_aug_padded(omega_cur, n_samples, n_padded, first)
+        om_dev = jax.device_put(aug, rep)
+        y = jax.device_put(
+            jnp.zeros((n_padded, l + 1), dtype=jnp.float32), rep
+        )
+        with obs.span(
+            "gramian.sketch.accumulate",
+            n=n_samples,
+            l=l,
+            sharded=True,
+            sketch_pass=p,
+        ):
+            if spans:
+                x_sharding = NamedSharding(mesh, P(None, all_axes))
+                v_div = _axis_product(mesh, P(all_axes))
+                idx_sharding = NamedSharding(mesh, P(None, None))
+                dense_pod = _sketch_pod_dense_kernel(mesh)
+                stream = _synced_carrier_stream(
+                    windows_factory(),
+                    n_samples,
+                    n_padded,
+                    mesh,
+                    density_threshold,
+                    width,
+                    v_div,
+                    x_sharding,
+                    idx_sharding,
+                    pipeline_depth=pipeline_depth,
+                    coalesce_variants=coalesce_variants,
+                )
+                for (
+                    route,
+                    payload,
+                    nnz,
+                    n_variants,
+                    step,
+                    n_win,
+                    stream_id,
+                ) in stream:
+                    with obs.span(
+                        "gramian.sketch.window",
+                        route=route,
+                        nnz=nnz,
+                        variants=n_variants,
+                        step=step,
+                        stream=stream_id,
+                        windows=n_win,
+                    ):
+                        if route == "scatter":
+                            y = _sketch_scatter_update(
+                                y, om_dev, payload
+                            )
+                        else:
+                            y = dense_pod(y, payload, om_dev)
+                    _note_sketch_window(route, count=n_win)
+            else:
+                for window_idx, lens in windows_factory():
+                    lens = np.asarray(lens)
+                    _check_indices(
+                        np.asarray(window_idx), n_samples
+                    )
+                    route = window_route(
+                        lens, n_samples, density_threshold
+                    )
+                    nnz = int(lens.sum())
+                    with obs.span(
+                        "gramian.sketch.window",
+                        route=route,
+                        nnz=nnz,
+                        variants=int(lens.size),
+                    ):
+                        if route == "scatter":
+                            idx = padded_carrier_matrix(
+                                window_idx,
+                                lens,
+                                sentinel=n_padded,
+                                n_rows=_pad_rows_for_scan(
+                                    lens.size
+                                ),
+                            )
+                            y = _sketch_scatter_update(
+                                y,
+                                om_dev,
+                                jax.device_put(idx, rep),
+                            )
+                        else:
+                            xb = _densify_window(
+                                window_idx,
+                                lens,
+                                n_samples,
+                                dense_panel_width(
+                                    int(lens.size), width
+                                ),
+                            )
+                            if n_padded != n_samples:
+                                xb = np.pad(
+                                    xb,
+                                    (
+                                        (0, n_padded - n_samples),
+                                        (0, 0),
+                                    ),
+                                )
+                            y = _sketch_dense_update(
+                                y,
+                                om_dev,
+                                jax.device_put(
+                                    pack_indicator_block(xb), rep
+                                ),
+                            )
+                    _note_sketch_window(route)
+        y_np = _replicated_np(y).astype(np.float64)
+        if not spans and jax.process_count() > 1:
+            # Host-local mesh on a multi-controller run: each host fed
+            # only its manifest slice — merge the partial panels.
+            from spark_examples_tpu.parallel.distributed import (
+                allreduce_gramian,
+            )
+
+            y_np = np.asarray(allreduce_gramian(y_np))
+        if first:
+            row_sums = y_np[:n_samples, -1].copy()
+        y_host = y_np[:, :-1]
+        y_host -= y_host[:n_samples].mean(axis=0, keepdims=True)
+        y_host[n_samples:] = 0.0
+        if p < power_iters:
+            q, _ = np.linalg.qr(y_host[:n_samples])
+            omega_cur = q.astype(np.float32)
+    from spark_examples_tpu.ops.sketch import SketchPanel
+
+    omega_final = np.zeros((n_padded, l), dtype=np.float64)
+    omega_final[:n_samples] = omega_cur.astype(np.float64)
+    omega_final[:n_samples] -= omega_final[:n_samples].mean(
+        axis=0, keepdims=True
+    )
+    return SketchPanel(
+        y=y_host,
+        omega=omega_final,
+        row_sums=row_sums,
+        n=n_samples,
+        k=k,
+        l=l,
+        seed=seed,
+        power_iters=power_iters,
+        mesh=mesh,
+        host_peak_bytes=sketch_host_bytes(n_padded, l),
+    )
+
+
+def _sketch_aug_padded(
+    omega: np.ndarray, n: int, n_padded: int, first: bool
+) -> np.ndarray:
+    """The streamed right-hand panel for mesh runs: centered Ω̃ over
+    the n real rows, zero pad rows, plus the companion column (ones on
+    the first pass — the row-sums/parity vector — zeros after, keeping
+    one executable geometry across passes)."""
+    aug = np.zeros((n_padded, omega.shape[1] + 1), dtype=np.float32)
+    aug[:n, :-1] = omega - omega.mean(axis=0, keepdims=True)
+    if first:
+        aug[:n, -1] = 1.0
+    return aug
+
+
+def sharded_sketch_finish(panel, k: int):
+    """The sketch Nyström finish on a mesh: device TSQR of the shifted
+    panel (:func:`sketch_tsqr` over the pod), the (k+p)×(k+p) core on
+    the host in f64, and one sharded matmul for the coordinates.
+    Returns ``(coords (n_padded, l), vals (l,))`` — the caller
+    (:func:`spark_examples_tpu.ops.sketch.sketch_eig`) trims, checks
+    the spectral gap, and sign-normalizes."""
+    from spark_examples_tpu.ops.sketch import _nystrom_core
+
+    mesh = panel.mesh
+    y, omega = panel.y, panel.omega
+    norm = float(np.linalg.norm(y))
+    if norm == 0.0:
+        return np.zeros((panel.n, panel.l)), np.zeros(panel.l)
+    nu = float(np.sqrt(panel.n) * np.finfo(np.float32).eps * norm)
+    y_nu = y + nu * omega
+    n_padded, l = y_nu.shape
+    rows_loc = n_padded // _axis_product(
+        mesh, P(tuple(mesh.axis_names))
+    )
+    b = omega.T @ y_nu
+    if rows_loc >= l:
+        q_dev, r_dev = sketch_tsqr(
+            jax.device_put(
+                y_nu.astype(np.float32),
+                NamedSharding(mesh, P(None, None)),
+            ),
+            mesh,
+        )
+        r = _replicated_np(r_dev).astype(np.float64)
+        u1, vals = _nystrom_core(r, b, nu)
+        coords_dev = jax.jit(
+            lambda qq, uu: qq @ uu,
+            out_shardings=NamedSharding(mesh, P(None, None)),
+        )(q_dev, jnp.asarray(u1.astype(np.float32)))
+        coords = _replicated_np(coords_dev).astype(np.float64)
+    else:
+        # Fewer rows per device than panel columns: the TSQR local QR
+        # shape contract breaks, and at that size the whole finish is
+        # host change money.
+        q, r = np.linalg.qr(y_nu)
+        u1, vals = _nystrom_core(r, b, nu)
+        coords = q @ u1
+    return coords, vals

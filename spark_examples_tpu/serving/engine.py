@@ -278,7 +278,14 @@ class AnalysisEngine:
                 return [tuple(row) for row in phmm.run_rows()]
         driver = self._driver(conf)
         with self._device_lock:
-            if self._deltas is None or self.mesh is not None:
+            if driver.sketch_selected():
+                # Gramian-free: ingest returns an O(N·(k+p))
+                # SketchPanel, not a G — it must never enter the
+                # delta/window caches (the delta algebra corrects N×N
+                # arrays, and sketch results are seed-specific), so the
+                # job runs the plain tier routing end to end.
+                g = driver.ingest_gramian()
+            elif self._deltas is None or self.mesh is not None:
                 g = driver.ingest_gramian()
             else:
                 g = jnp.asarray(self._gramian_delta_aware(driver, conf))

@@ -68,6 +68,7 @@ _SPEC_FIELDS = frozenset(
         "samples",
         "exclude_samples",
         "read_group_set_id",
+        "pca_mode",
     }
 )
 
@@ -89,6 +90,7 @@ _PCA_ONLY_FIELDS = (
     "num_pc",
     "samples",
     "exclude_samples",
+    "pca_mode",
 )
 
 
@@ -130,6 +132,12 @@ class JobSpec:
     # the spec surface the delta tier's ±k cohort queries ride.
     samples: Optional[Tuple[str, ...]] = None
     exclude_samples: Optional[Tuple[str, ...]] = None
+    # Per-job PCA engine override (None = the server's configured
+    # --pca-mode). The servable surface for the Gramian-free sketch
+    # engine: a huge-N cohort submits {"pca_mode": "sketch"} and rides
+    # the O(N·(k+p)) panel instead of 413-ing on the tile-footprint
+    # bound. Validated against utils.config.PCA_MODES.
+    pca_mode: Optional[str] = None
     # Job kind: "pca" (default) or "pairhmm" (read-side scoring).
     kind: str = "pca"
     # Readset filter for pairhmm jobs (None = the server's configured
@@ -201,6 +209,15 @@ class JobSpec:
         refs = rec.get("references")
         if refs is not None and not isinstance(refs, str):
             raise ValueError("references must be a string")
+        pca_mode = rec.get("pca_mode")
+        if pca_mode is not None:
+            from spark_examples_tpu.utils.config import PCA_MODES
+
+            if pca_mode not in PCA_MODES:
+                raise ValueError(
+                    f"unknown pca_mode {pca_mode!r} (expected one of "
+                    f"{list(PCA_MODES)})"
+                )
         all_refs = rec.get("all_references")
         return cls(
             tenant=str(rec.get("tenant", "anonymous")) or "anonymous",
@@ -214,6 +231,7 @@ class JobSpec:
             priority=priority,
             samples=_sample_list(rec, "samples"),
             exclude_samples=_sample_list(rec, "exclude_samples"),
+            pca_mode=pca_mode,
             kind=kind,
             read_group_set_id=rgsid,
         )
@@ -248,6 +266,10 @@ class JobSpec:
             rec["samples"] = list(self.samples)
         if self.exclude_samples is not None:
             rec["exclude_samples"] = list(self.exclude_samples)
+        # Omitted when unset, like the restriction fields: pre-sketch
+        # journals replay unchanged.
+        if self.pca_mode is not None:
+            rec["pca_mode"] = self.pca_mode
         # No "kind" key on the default kind: pre-kind journals and
         # their replayed record shapes stay byte-for-byte what round 12
         # wrote (and their cohort keys stay identical).
@@ -287,7 +309,12 @@ def resolve_spec(spec: JobSpec, base: Any) -> Dict[str, Any]:
             ),
             "pairhmm_gap_ext_phred": float(base.pairhmm_gap_ext_phred),
         }
-    return {
+    resolved_mode = (
+        spec.pca_mode
+        if spec.pca_mode is not None
+        else getattr(base, "pca_mode", "auto")
+    )
+    out = {
         "variant_set_ids": list(
             spec.variant_set_ids or base.variant_set_ids
         ),
@@ -314,6 +341,22 @@ def resolve_spec(spec: JobSpec, base: Any) -> Dict[str, Any]:
             spec.exclude_samples, base, "exclude_samples"
         ),
     }
+    if resolved_mode == "sketch":
+        # Every EXACT engine is bit-identical on the same cohort, so
+        # pca_mode has never been part of the resolved identity (and
+        # pre-sketch journals/caches keep their keys). The sketch
+        # engine is approximate and seeded — a sketch job's result is
+        # a different artifact from the exact result AND from other
+        # sketch parameterizations, so all of its knobs join the key.
+        out["pca_mode"] = "sketch"
+        out["sketch_oversample"] = int(
+            getattr(base, "sketch_oversample", 8)
+        )
+        out["sketch_seed"] = int(getattr(base, "sketch_seed", 0))
+        out["sketch_power_iters"] = int(
+            getattr(base, "sketch_power_iters", 0)
+        )
+    return out
 
 
 def _resolved_samples(
@@ -364,6 +407,15 @@ def job_config(
             metrics_out=None,
             manifest_out=None,
         )
+    pca_mode = (
+        spec.pca_mode
+        if spec.pca_mode is not None
+        else getattr(base, "pca_mode", "auto")
+    )
+    if pca_mode == "sketch":
+        # The sketch driver refuses checkpointed ingest (no snapshot
+        # grid for a partial panel) — never hand it one.
+        checkpoint_dir = None
     return dataclasses.replace(
         base,
         variant_set_ids=resolved["variant_set_ids"],
@@ -373,6 +425,7 @@ def job_config(
         num_pc=resolved["num_pc"],
         samples=resolved["samples"],
         exclude_samples=resolved["exclude_samples"],
+        pca_mode=pca_mode,
         checkpoint_dir=checkpoint_dir,
         elastic_checkpoint=False,
         output_path=None,

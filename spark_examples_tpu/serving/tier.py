@@ -649,6 +649,12 @@ class AnalysisJobTier:
             lead_index = engine.index_for(
                 tuple(lead_conf.variant_set_ids)
             )
+            if getattr(lead_conf, "pca_mode", "auto") == "sketch":
+                # Gangs stack N×N Gramian tiles on a batch axis; a
+                # sketch job has no Gramian to stack (and its result
+                # is engine-specific) — it runs solo through the
+                # driver's own sketch routing.
+                return []
             if engine.cohort_size(lead_conf, lead_index) > self._gang_max:
                 return []
             if engine.delta_resolvable(lead_conf):
@@ -662,7 +668,8 @@ class AnalysisJobTier:
             try:
                 conf = job_config(other.spec, self._base)
                 return (
-                    engine.gang_key(conf) == lead_key
+                    getattr(conf, "pca_mode", "auto") != "sketch"
+                    and engine.gang_key(conf) == lead_key
                     and engine.cohort_size(conf, lead_index)
                     <= self._gang_max
                 )

@@ -15,6 +15,10 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from spark_examples_tpu.arrays.blocks import DEFAULT_BLOCK_VARIANTS
+from spark_examples_tpu.ops.pcoa import (
+    DEFAULT_RANDOMIZED_OVERSAMPLE,
+    DEFAULT_SKETCH_POWER_ITERS,
+)
 from spark_examples_tpu.resilience.breaker import (
     DEFAULT_COOLDOWN_S,
     DEFAULT_FAILURE_THRESHOLD,
@@ -31,11 +35,19 @@ from spark_examples_tpu.genomics.shards import (
 
 __all__ = [
     "GenomicsConfig",
+    "PCA_MODES",
     "PcaConfig",
     "add_analyze_flags",
     "add_genomics_flags",
     "add_pca_flags",
 ]
+
+# THE --pca-mode registry: the one place the allowed-mode set lives.
+# argparse choices, the driver's programmatic validation + its error
+# message, the serving JobSpec's per-job override validation, and the
+# auto-selection gates all derive from this tuple — adding an engine is
+# a one-line change here (a sync test pins every consumer against it).
+PCA_MODES = ("auto", "fused", "stream", "sparse", "sketch")
 
 def _csv_list(value: str) -> List[str]:
     """argparse type for comma-separated id lists (empty items dropped,
@@ -120,8 +132,27 @@ class PcaConfig(GenomicsConfig):
     # accumulates by OOB-drop scatter straight from CSR carrier
     # windows — no densify, no bit-pack, work O(Σk²) instead of
     # O(N²·V) — 2-D tile-sharded over the mesh when one is configured,
-    # finishing through the sharded randomized eig.
+    # finishing through the sharded randomized eig; "sketch" forces the
+    # Gramian-FREE engine (ops/sketch.py): the same CSR windows
+    # accumulate an (N, k+p) randomized sketch panel instead of any N×N
+    # tile — O(N·(k+p)) memory, TSQR + Nyström finish — the
+    # million-sample route (auto selects it only where the N² footprint
+    # bound would refuse). The allowed set is the PCA_MODES registry
+    # above.
     pca_mode: str = "auto"
+    # Gramian-free sketch engine knobs (--pca-mode sketch). Oversample
+    # p: the panel carries k+p columns through ops/pcoa.
+    # randomized_panel_width — the ONE panel-width policy the exact
+    # randomized finish shares. Seed: Ω is drawn from a seeded
+    # generator, so a run is bit-reproducible for a fixed seed +
+    # topology (NOT bit-identical to the exact path — the documented
+    # tolerance contract in ops/sketch.py is the correctness bar).
+    # Power iterations: extra full streamed passes with Ω ← orth(Y)
+    # between them; 0 keeps the single-pass cold-stream discipline,
+    # ≥ 2 tightens coordinates toward the top-k tolerance bars.
+    sketch_oversample: int = DEFAULT_RANDOMIZED_OVERSAMPLE
+    sketch_seed: int = 0
+    sketch_power_iters: int = DEFAULT_SKETCH_POWER_ITERS
     # Dense/sparse switch for the sparse-aware Gramian: a window whose
     # carrier density (nnz / (N·V_blk)) is strictly below this scatters
     # straight from CSR; at or above it, it densifies onto the MXU
@@ -536,7 +567,7 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument(
         "--pca-mode",
-        choices=("auto", "fused", "stream", "sparse"),
+        choices=PCA_MODES,
         default="auto",
         help="PCA pipeline route: 'auto' (default) runs the fused single-"
         "dispatch finish (centering + subspace eig + row sums in one "
@@ -550,7 +581,46 @@ def add_pca_flags(p: argparse.ArgumentParser) -> None:
         "— the biobank-scale route; a process-spanning mesh runs the "
         "per-window carrier-allgather protocol: ~d*N*V sparse carrier "
         "integers cross hosts per window instead of dense packed "
-        "panels)",
+        "panels); 'sketch' forces the Gramian-FREE randomized sketch "
+        "engine (ops/sketch.py): the same CSR windows accumulate an "
+        "(N, k+p) panel — no N×N tile anywhere, O(N*(k+p)) memory, "
+        "mesh TSQR + Nystrom finish — the million-sample route, "
+        "tolerance-pinned against the exact spectrum (see "
+        "--sketch-seed); auto only selects it where the N^2 footprint "
+        "bound would refuse",
+    )
+    p.add_argument(
+        "--sketch-oversample",
+        type=int,
+        default=PcaConfig.sketch_oversample,
+        help="Sketch-engine panel oversampling p (--pca-mode sketch): "
+        "the streamed panel carries k+p columns (via the shared "
+        "randomized_panel_width policy, floor p >= 1 so the spectral-"
+        "gap check always has a value past k). Larger p tightens the "
+        "approximation at O(N*p) memory and per-window FLOP cost; "
+        "p >= N-k makes the Nystrom reconstruction exact to roundoff "
+        "(the full-rank tolerance regime)",
+    )
+    p.add_argument(
+        "--sketch-seed",
+        type=int,
+        default=PcaConfig.sketch_seed,
+        help="Seed of the sketch engine's Gaussian test matrix "
+        "(--pca-mode sketch): a fixed seed + topology reproduces "
+        "coordinates bit-for-bit; different seeds agree within the "
+        "documented spectrum tolerance (ops/sketch.py), NOT "
+        "bit-identically — the sketch path is approximate by design",
+    )
+    p.add_argument(
+        "--sketch-power-iters",
+        type=int,
+        default=PcaConfig.sketch_power_iters,
+        help="Extra full streamed passes of the sketch engine with "
+        "Omega <- orth(Y) between them (--pca-mode sketch): 0 "
+        "(default) keeps the one-streamed-pass cold-stream "
+        "discipline; >= 2 sharpens coordinates to the top-k "
+        "tolerance bars on gapped spectra. Each pass re-streams every "
+        "CSR window once",
     )
     p.add_argument(
         "--sparse-density-threshold",

@@ -191,9 +191,9 @@ class SpanContractRule:
     code = CODE
     summary = (
         "spans are context-managed; ingest.*/job.*/gramian.sparse.*/"
-        "pairhmm.* span names, pod.* instant names, and wire/ingest/"
-        "serving/sparse metric registrations match "
-        "scripts/validate_trace.py exactly"
+        "gramian.sketch.*/pairhmm.* span names, pod.* instant names, "
+        "and wire/ingest/serving/sparse/sketch metric registrations "
+        "match scripts/validate_trace.py exactly"
     )
     project_wide = True
 
@@ -230,6 +230,7 @@ class SpanContractRule:
             ("ingest.", "_INGEST_SPANS"),
             ("job.", "_JOB_SPANS"),
             ("gramian.sparse.", "_SPARSE_SPANS"),
+            ("gramian.sketch.", "_SKETCH_SPANS"),
             ("pairhmm.", "_PAIRHMM_SPANS"),
         ):
             emitted = {n for n in span_names if n.startswith(prefix)}
