@@ -17,12 +17,21 @@
 # bit-identical to the uninterrupted baseline
 # (tests/test_replica.py::TestReplicaChaosSoak).
 #
+# Round 19 adds a FOURTH leg: the crashsim durability sweep
+# (python -m tools.crashsim) — record each persistence workload's
+# fs-op log, enumerate EVERY crash prefix (torn/floor variants
+# included), materialize the crashed states, and run the real recovery
+# code against each. The seeded chaos legs above kill at the
+# hand-placed torn-write seams; crashsim crashes at every point the
+# seams might have missed.
+#
 # Usage:
 #   scripts/chaos_soak.sh                 # CHAOS_SOAK_ITERS=5, SERVICE_SOAK_ITERS=2,
-#                                         # REPLICA_SOAK_ITERS=2
+#                                         # REPLICA_SOAK_ITERS=2, CRASHSIM_ITERS=1
 #   CHAOS_SOAK_ITERS=25 scripts/chaos_soak.sh
 #   SERVICE_SOAK_ITERS=10 scripts/chaos_soak.sh
 #   REPLICA_SOAK_ITERS=10 scripts/chaos_soak.sh
+#   CRASHSIM_ITERS=5 scripts/chaos_soak.sh
 #   scripts/chaos_soak.sh -k randomized   # extra pytest args pass through
 #
 # The deterministic resilience + serving suites (tier-1) live in the
@@ -36,6 +45,7 @@ cd "$(dirname "$0")/.."
 : "${CHAOS_SOAK_ITERS:=5}"
 : "${SERVICE_SOAK_ITERS:=2}"
 : "${REPLICA_SOAK_ITERS:=2}"
+: "${CRASHSIM_ITERS:=1}"
 
 # Each leg tolerates pytest exit 5 ("no tests matched") so a -k filter
 # aimed at one leg doesn't fail the other — but BOTH matching nothing
@@ -60,6 +70,15 @@ run_leg() {
 run_leg tests/test_resilience.py "$@"
 run_leg tests/test_serving.py "$@"
 run_leg tests/test_replica.py "$@"
+
+# Crashsim durability leg: not a pytest leg (no -k routing, nothing to
+# filter) — the sweep either recovers every crashed state or fails the
+# soak. CRASHSIM_ITERS=0 skips it explicitly.
+if [ "$CRASHSIM_ITERS" -gt 0 ]; then
+    env JAX_PLATFORMS=cpu \
+        python -m tools.crashsim --iters "$CRASHSIM_ITERS"
+    ran=1
+fi
 
 if [ "$ran" = 0 ]; then
     echo "chaos_soak: no tests matched in either leg" >&2

@@ -141,7 +141,11 @@ class FlightRecorder:
         return records
 
     def dump(self, path: str, reason: str) -> None:
-        """Write header + records as JSONL, atomically (tmp + rename)."""
+        """Write header + records as JSONL, atomically (tmp + fsync +
+        rename — a torn flight record is exactly as useless during the
+        incident it exists for as no record at all)."""
+        from spark_examples_tpu.resilience import faults
+
         directory = os.path.dirname(os.path.abspath(path))
         os.makedirs(directory, exist_ok=True)
         header = {
@@ -169,6 +173,12 @@ class FlightRecorder:
                         }
                     )
                 f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+            # Torn-write seam: crashsim (and the chaos suite) kill the
+            # dump mid-write here; without the fsync above, the rename
+            # below could land a torn dump under the committed name.
+            faults.inject_write("flightrec.write", tmp)
         os.replace(tmp, path)
 
 

@@ -165,7 +165,7 @@ def _note_sketch_window(route: str, count: int = 1) -> None:
 
 
 @partial(jax.jit, donate_argnums=(0,))
-def _sketch_scatter_update(y, omega, idx):
+def _sketch_scatter_update(y: Any, omega: Any, idx: Any) -> Any:
     """One sparse-route window into the panel: ``Y += X·(Xᵀ·Ω̃)``
     without forming X. ``idx`` is the padded carrier matrix
     ``(V_pad, k_bucket)`` (V_pad a multiple of the scan chunk,
@@ -179,7 +179,7 @@ def _sketch_scatter_update(y, omega, idx):
         idx.shape[1],
     )
 
-    def body(acc, ci):
+    def body(acc: Any, ci: Any) -> Tuple[Any, None]:
         rows = omega.at[ci].get(mode="fill", fill_value=0)
         t = jnp.sum(rows, axis=1)
         upd = jnp.broadcast_to(t[:, None, :], rows.shape)
@@ -190,7 +190,7 @@ def _sketch_scatter_update(y, omega, idx):
 
 
 @partial(jax.jit, donate_argnums=(0,))
-def _sketch_dense_update(y, omega, xp):
+def _sketch_dense_update(y: Any, omega: Any, xp: Any) -> Any:
     """One dense-route window: unpack the bit-packed indicator panel
     (the same pow2-bucketed packed bytes the Gramian MXU path ships)
     and ride two MXU matmuls — ``Y += X·(Xᵀ·Ω̃)``."""
@@ -400,7 +400,7 @@ def _nystrom_core(
 
 
 def sketch_eig(
-    panel: SketchPanel, k: int, timer=None
+    panel: SketchPanel, k: int, timer: Any = None
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Top-k eigenpairs of the centered Gramian from a sketch panel.
 

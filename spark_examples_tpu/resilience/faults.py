@@ -62,6 +62,15 @@ Sites wired in this round (glob-matched, so ``transport.*`` works):
                             interpreted as a STALE FENCING TOKEN — the
                             op raises ``FencedWriteError``, the zombie-
                             write shape)
+``store.lease.write``       lease-doc file commit (torn = kill -9 between
+                            fsync and rename: reads back as "no lease"
+                            with the token floor intact)
+``serving.delta.write``     delta-cache persist-dir commit (torn = the
+                            ``.tmp-`` partial the next load sweeps; the
+                            entry stays memory-only)
+``flightrec.write``         crash flight-recorder dump commit (torn =
+                            kill mid-dump; the previous dump under the
+                            final name survives untouched)
 ==========================  =================================================
 
 The serving seams (``serving.job.run``/``serving.job.kill``/

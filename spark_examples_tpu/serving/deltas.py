@@ -248,6 +248,8 @@ class DeltaIndex:
         purpose: ``FencedWriteError`` is RuntimeError-shaped, so the
         disk-weather catch below can never degrade a zombie's rejected
         write into a warning."""
+        from spark_examples_tpu.resilience import faults
+
         path = self._entry_path(entry)
         if path is None:
             return
@@ -265,6 +267,10 @@ class DeltaIndex:
                 )
                 f.flush()
                 os.fsync(f.fileno())
+                # Torn-write seam (InjectedFault is IOError-shaped, so
+                # the disk-weather catch below handles it like any
+                # mid-write crash: warn, sweep the tmp, stay in memory).
+                faults.inject_write("serving.delta.write", tmp)
             os.replace(tmp, path)
             # Our own write needs no rescan pickup.
             self._seen_files.add(os.path.basename(path))

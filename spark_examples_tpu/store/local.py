@@ -337,6 +337,9 @@ class LocalDirStore(DurableStore):
                 f.write(json.dumps(doc, sort_keys=True).encode("utf-8"))
                 f.flush()
                 os.fsync(f.fileno())
+                # Torn-write seam: a lease doc killed mid-write must
+                # read back as "no lease" with the token floor intact.
+                faults.inject_write("store.lease.write", tmp)
             os.replace(tmp, path)
         except OSError as e:
             raise StoreError(f"lease write {name!r} failed: {e}") from e

@@ -1,0 +1,358 @@
+"""crashsim (tools/crashsim): the crash-consistency harness's own tests.
+
+Four layers:
+
+1. **Recorder units** — interposition captures exactly the
+   durability-relevant ops, relative to the root, and restores the
+   patched functions on exit.
+2. **Model units** — the crashed-state semantics the scenarios rely
+   on: volatile content propagates THROUGH renames (the ALICE failure
+   class), fsync pins the durable floor, the floor variant of a
+   never-synced file is absence.
+3. **Planted-bug detection** — a workload that renames WITHOUT fsync
+   must produce violations. A harness that cannot catch the bug it
+   exists for proves nothing; this is crashsim's own golden positive.
+4. **The real scenarios** — every shipped scenario recovers from every
+   enumerated crashed state (the acceptance bar), the enumeration
+   covers the four required commit points, and the CLI gates.
+"""
+
+import builtins
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.crashsim.harness import run_scenario, write_report
+from tools.crashsim.model import (
+    CrashInfo,
+    enumerate_crash_states,
+    materialize,
+)
+from tools.crashsim.recorder import FsOp, OpRecorder
+from tools.crashsim.scenarios import SCENARIOS, Scenario
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestRecorder:
+    def test_captures_commit_sequence(self, tmp_path):
+        root = str(tmp_path)
+        with OpRecorder(root) as rec:
+            tmp = os.path.join(root, "doc.tmp")
+            with open(tmp, "wb") as f:
+                f.write(b"payload")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(root, "doc"))
+        kinds = [(op.kind, op.path) for op in rec.ops]
+        assert kinds == [
+            ("write", "doc.tmp"),
+            ("fsync", "doc.tmp"),
+            ("rename", "doc.tmp"),
+        ]
+        assert rec.ops[0].content == b"payload"
+        assert rec.ops[2].dst == "doc"
+
+    def test_ignores_paths_outside_root(self, tmp_path):
+        inside = tmp_path / "in"
+        outside = tmp_path / "out"
+        inside.mkdir()
+        outside.mkdir()
+        with OpRecorder(str(inside)) as rec:
+            with open(outside / "other", "w") as f:
+                f.write("x")
+            os.mkdir(outside / "d")
+        assert rec.ops == []
+
+    def test_read_opens_pass_through_unwrapped(self, tmp_path):
+        (tmp_path / "existing").write_bytes(b"abc")
+        with OpRecorder(str(tmp_path)) as rec:
+            with open(tmp_path / "existing", "rb") as f:
+                assert f.read() == b"abc"
+        assert rec.ops == []
+
+    def test_restores_patched_functions(self, tmp_path):
+        orig_open, orig_fsync = builtins.open, os.fsync
+        orig_replace, orig_mkdir = os.replace, os.mkdir
+        with OpRecorder(str(tmp_path)):
+            assert builtins.open is not orig_open
+        assert builtins.open is orig_open
+        assert os.fsync is orig_fsync
+        assert os.replace is orig_replace
+        assert os.mkdir is orig_mkdir
+
+    def test_not_reentrant(self, tmp_path):
+        with OpRecorder(str(tmp_path)) as rec:
+            with pytest.raises(RuntimeError):
+                rec.__enter__()
+
+    def test_makedirs_resolves_through_patched_mkdir(self, tmp_path):
+        with OpRecorder(str(tmp_path)) as rec:
+            os.makedirs(os.path.join(str(tmp_path), "a", "b"))
+        assert [(op.kind, op.path) for op in rec.ops] == [
+            ("mkdir", "a"),
+            ("mkdir", os.path.join("a", "b")),
+        ]
+
+
+class TestModel:
+    def test_volatile_content_propagates_through_rename(self):
+        """The ALICE pessimism the whole harness is built on: a rename
+        of a never-fsynced file can expose a torn image under the
+        DESTINATION name."""
+        ops = [
+            FsOp("write", "doc.tmp", content=b"0123456789"),
+            FsOp("rename", "doc.tmp", dst="doc"),
+        ]
+        states = list(enumerate_crash_states(ops))
+        torn_under_final = [
+            s
+            for s in states
+            if s.variant == "torn" and dict(s.files).get("doc")
+        ]
+        assert torn_under_final, "torn state must surface under 'doc'"
+        torn = dict(torn_under_final[0].files)["doc"]
+        assert torn and torn != b"0123456789"
+        assert b"0123456789".startswith(torn)
+
+    def test_fsync_pins_the_floor(self):
+        ops = [
+            FsOp("write", "doc.tmp", content=b"0123456789"),
+            FsOp("fsync", "doc.tmp"),
+            FsOp("rename", "doc.tmp", dst="doc"),
+        ]
+        for state in enumerate_crash_states(ops):
+            if state.n_ops == 3:
+                # Post-fsync, post-rename: nothing is volatile — only
+                # the full image exists and it is complete.
+                assert state.variant == "full"
+                assert dict(state.files)["doc"] == b"0123456789"
+
+    def test_floor_of_never_synced_file_is_absence(self):
+        ops = [FsOp("write", "doc.tmp", content=b"abc")]
+        by_variant = {
+            s.variant: s
+            for s in enumerate_crash_states(ops)
+            if s.n_ops == 1
+        }
+        assert "doc.tmp" not in dict(by_variant["floor"].files)
+        assert dict(by_variant["full"].files)["doc.tmp"] == b"abc"
+
+    def test_directory_rename_moves_subtree(self):
+        ops = [
+            FsOp("mkdir", "staging"),
+            FsOp("write", "staging/a", content=b"a"),
+            FsOp("fsync", "staging/a"),
+            FsOp("rename", "staging", dst="final"),
+        ]
+        final = list(enumerate_crash_states(ops))[-1]
+        assert dict(final.files) == {"final/a": b"a"}
+        assert final.dirs == ("final",)
+
+    def test_materialize_back_dates_artifacts(self, tmp_path):
+        import time
+
+        ops = [
+            FsOp("mkdir", "lockdir.lck"),
+            FsOp("write", "doc", content=b"x"),
+        ]
+        state = next(
+            s
+            for s in enumerate_crash_states(ops)
+            if s.n_ops == 2 and s.variant == "full"
+        )
+        dest = str(tmp_path / "crash")
+        materialize(state, dest)
+        for rel in ("lockdir.lck", "doc"):
+            age = time.time() - os.path.getmtime(os.path.join(dest, rel))
+            assert age > 3000, (
+                "crashed artifacts must read as PAST so mtime-based "
+                "stale-breakers fire instead of waiting out a ghost"
+            )
+
+    def test_crash_info_helpers(self):
+        info = CrashInfo(
+            ops=[
+                FsOp("write", "a/doc.tmp", content=b"x"),
+                FsOp("fsync", "a/doc.tmp"),
+                FsOp("rename", "a/doc.tmp", dst="a/doc"),
+            ]
+        )
+        assert info.renames_to("a/doc") == 1
+        assert info.fsyncs_of("doc.tmp") == 1
+        assert info.writes_of(".tmp") == [b"x"]
+
+
+class TestPlantedBug:
+    """The harness's golden positive: rename-without-fsync MUST be
+    caught, and the same workload with the fsync restored must pass —
+    the detector works and does not cry wolf."""
+
+    @staticmethod
+    def _scenario(fsync_before_rename):
+        def workload(root):
+            tmp = os.path.join(root, "doc.tmp")
+            with open(tmp, "wb") as f:
+                f.write(b"0123456789abcdef")
+                f.flush()
+                if fsync_before_rename:
+                    os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(root, "doc"))
+
+        def check(root, info):
+            path = os.path.join(root, "doc")
+            if not os.path.exists(path):
+                return None
+            with open(path, "rb") as f:
+                content = f.read()
+            if content != b"0123456789abcdef":
+                return "partial file visible under the committed name"
+            return None
+
+        return Scenario("planted", "planted bug", workload, check)
+
+    def test_missing_fsync_is_detected(self, tmp_path):
+        res = run_scenario(
+            self._scenario(fsync_before_rename=False), str(tmp_path)
+        )
+        assert not res.ok
+        assert any(
+            v.variant in ("torn", "floor") for v in res.violations
+        )
+
+    def test_fsynced_variant_is_clean(self, tmp_path):
+        res = run_scenario(
+            self._scenario(fsync_before_rename=True), str(tmp_path)
+        )
+        assert res.ok, [v.message for v in res.violations]
+
+    def test_throwing_recovery_is_a_violation(self, tmp_path):
+        sc = Scenario(
+            "raiser",
+            "recovery that throws",
+            lambda root: open(
+                os.path.join(root, "f"), "wb"
+            ).close(),
+            lambda root, info: (_ for _ in ()).throw(
+                ValueError("recovery exploded")
+            ),
+        )
+        res = run_scenario(sc, str(tmp_path))
+        assert not res.ok
+        assert "recovery raised" in res.violations[0].message
+
+
+class TestScenarios:
+    @pytest.mark.parametrize(
+        "scenario", SCENARIOS, ids=[s.name for s in SCENARIOS]
+    )
+    def test_every_crashed_state_recovers(self, tmp_path, scenario):
+        """The acceptance bar, per scenario: every enumerated crashed
+        state runs the real recovery code and every invariant holds."""
+        res = run_scenario(scenario, str(tmp_path))
+        assert res.n_ops > 0, "workload recorded nothing"
+        assert res.n_states > res.n_ops, "variants missing"
+        assert res.ok, [
+            f"crash@{v.n_ops}/{v.variant}: {v.message}"
+            for v in res.violations
+        ]
+
+    def test_required_commit_points_are_covered(self):
+        """ISSUE acceptance: the enumeration reaches (at least) the
+        store lease CAS, the journal append, the mirror staging
+        commit, and the delta persist."""
+        names = {s.name for s in SCENARIOS}
+        assert {
+            "store-lease-cas",
+            "journal-append",
+            "mirror-staging",
+            "delta-persist",
+        } <= names
+
+    def test_scenario_workloads_hit_their_commit_renames(self, tmp_path):
+        """Each scenario's op log must actually contain an atomic
+        rename — a workload that never commits enumerates trivially
+        and verifies nothing."""
+        by_name = {s.name: s for s in SCENARIOS}
+        sc = by_name["store-put"]
+        work = tmp_path / "w"
+        work.mkdir()
+        with OpRecorder(str(work)) as rec:
+            sc.workload(str(work))
+        renames = [op for op in rec.ops if op.kind == "rename"]
+        fsyncs = [op for op in rec.ops if op.kind == "fsync"]
+        assert renames and fsyncs
+        # fsync-before-rename order, per commit:
+        first_rename = rec.ops.index(renames[0])
+        assert any(
+            rec.ops.index(f) < first_rename for f in fsyncs
+        )
+
+
+class TestCli:
+    def test_list(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.crashsim", "--list"],
+            capture_output=True,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0
+        for sc in SCENARIOS:
+            assert sc.name in proc.stdout
+
+    def test_unknown_scenario_is_usage_error(self):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "tools.crashsim",
+                "--scenario",
+                "no-such-thing",
+            ],
+            capture_output=True,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 2
+
+    def test_single_scenario_run_writes_jsonl(self, tmp_path):
+        out = str(tmp_path / "report.jsonl")
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "tools.crashsim",
+                "--scenario",
+                "flightrec-dump",
+                "--out",
+                out,
+            ],
+            capture_output=True,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        lines = [
+            json.loads(line)
+            for line in open(out, encoding="utf-8")
+            if line.strip()
+        ]
+        assert lines and lines[0]["kind"] == "scenario"
+        assert lines[0]["ok"] is True
+
+    def test_report_shape_for_violations(self, tmp_path):
+        import io
+
+        res = run_scenario(
+            TestPlantedBug._scenario(False), str(tmp_path)
+        )
+        buf = io.StringIO()
+        write_report([res], buf)
+        lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+        assert lines[0]["kind"] == "scenario"
+        assert lines[0]["ok"] is False
+        assert any(x["kind"] == "violation" for x in lines[1:])

@@ -46,6 +46,8 @@ SINGLE_FILE_RULES = [
     ("gl010", "collective-congruence", ".py"),
     ("gl011", "donation-aliasing", ".py"),
     ("gl012", "retrace-discipline", ".py"),
+    ("gl013", "atomic-commit", ".py"),
+    ("gl014", "fencing-discipline", ".py"),
 ]
 
 

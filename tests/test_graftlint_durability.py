@@ -1,0 +1,320 @@
+"""The durability rules (GL013 atomic-commit, GL014 fencing-discipline,
+GL015 journal-compat) and the SARIF emitter.
+
+The single-file golden fixtures for GL013/GL014 ride the shared
+parametrization in test_graftlint.py; this file holds what is specific
+to round 19: the GL015 directory fixtures (registry + writer + reader
+mini-projects), the both-directions drift assertions, the
+flow-sensitivity cases the golden files keep simple, the
+registry-sharing meta-test (the same module object feeds the static
+rule, the mixed-version replay test, and crashsim), and the SARIF
+document shape CI uploads.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from spark_examples_tpu.serving import journal_schema
+from tools.graftlint.engine import Finding, run_lint, sarif_document
+from tools.graftlint.rules import ALL_RULES
+from tools.graftlint.rules.journal_compat import load_registry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tools", "graftlint", "fixtures")
+
+ALL_RULE_NAMES = [r.name for r in ALL_RULES]
+
+
+def _mini_project(tmp_path, rule_name, fixture_files, extra_rule_cfg=()):
+    lines = ["[tool.graftlint]", "exclude = []"]
+    for name in ALL_RULE_NAMES:
+        lines.append(f'[tool.graftlint.rules."{name}"]')
+        lines.append(f"enabled = {'true' if name == rule_name else 'false'}")
+        if name == rule_name:
+            lines.append('paths = ["."]')
+            lines.extend(extra_rule_cfg)
+    (tmp_path / "pyproject.toml").write_text("\n".join(lines) + "\n")
+    for f in fixture_files:
+        shutil.copy(os.path.join(FIXTURES, f), tmp_path)
+    return str(tmp_path)
+
+
+def _gl015_project(tmp_path, kind):
+    src = os.path.join(FIXTURES, f"gl015_{kind}")
+    for f in os.listdir(src):
+        shutil.copy(os.path.join(src, f), tmp_path)
+    return _mini_project(
+        tmp_path,
+        "journal-compat",
+        [],
+        extra_rule_cfg=['registry_module = "registry.py"'],
+    )
+
+
+class TestJournalCompatFixtures:
+    def test_positive_reports_every_drift_direction(self, tmp_path):
+        root = _gl015_project(tmp_path, "positive")
+        findings, suppressed = run_lint(root, [])
+        assert findings and not suppressed
+        assert all(f.rule == "journal-compat" for f in findings)
+        messages = "\n".join(f.message for f in findings)
+        # writer → registry drift:
+        assert "'shard' not in the shared registry" in messages
+        assert "event kind 'retry'" in messages
+        assert "'attempts' not in journal_schema.JOB_RECORD_KEYS" in messages
+        # reader drift + absence-intolerance:
+        assert "accesses journal key 'unknown'" in messages
+        assert "OPTIONAL journal key 'trace'" in messages
+        # registry → code drift (staleness), both record kinds:
+        assert "journal key 'trace' is written by no" in messages
+        assert "job-record key 'error' is written by" in messages
+
+    def test_negative_clean(self, tmp_path):
+        root = _gl015_project(tmp_path, "negative")
+        findings, suppressed = run_lint(root, [])
+        assert findings == []
+        assert not suppressed
+
+    def test_pragma_suppresses_and_counts(self, tmp_path):
+        root = _gl015_project(tmp_path, "suppressed")
+        findings, suppressed = run_lint(root, [])
+        assert findings == []
+        assert suppressed.get("journal-compat", 0) >= 1
+
+    def test_cli_exits_nonzero_on_positive(self, tmp_path):
+        root = _gl015_project(tmp_path, "positive")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--root", root],
+            capture_output=True,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "GL015" in proc.stdout
+
+    def test_absent_registry_disables_rule(self, tmp_path):
+        """Mini-projects without the registry module (every other
+        rule's fixtures) must not trip GL015 — the GL003 pattern."""
+        root = _mini_project(
+            tmp_path,
+            "journal-compat",
+            [],
+            extra_rule_cfg=['registry_module = "registry.py"'],
+        )
+        (tmp_path / "writer.py").write_text(
+            'def f(j):\n    j.append({"e": "bogus", "zzz": 1})\n'
+        )
+        findings, _ = run_lint(root, [])
+        assert findings == []
+
+    def test_registry_is_the_shared_module(self):
+        """The rule importlib-loads the SAME key sets the serving code,
+        the replay test, and crashsim import — drift is impossible."""
+        mod = load_registry(
+            REPO_ROOT, "spark_examples_tpu/serving/journal_schema.py"
+        )
+        assert mod is not None
+        assert set(mod.JOURNAL_KEYS) == set(journal_schema.JOURNAL_KEYS)
+        assert set(mod.JOURNAL_EVENT_KINDS) == set(
+            journal_schema.JOURNAL_EVENT_KINDS
+        )
+        assert set(mod.JOB_RECORD_KEYS) == set(
+            journal_schema.JOB_RECORD_KEYS
+        )
+        # Required/optional partition the key set — an overlap would
+        # make absence-tolerance ambiguous.
+        assert not (
+            set(mod.JOURNAL_REQUIRED_KEYS) & set(mod.JOURNAL_OPTIONAL_KEYS)
+        )
+
+
+class TestAtomicCommitFlow:
+    """Flow-sensitivity beyond the golden files: the fsync must reach
+    the rename on EVERY path, not just one."""
+
+    def _lint_snippet(self, tmp_path, body):
+        root = _mini_project(tmp_path, "atomic-commit", [])
+        (tmp_path / "mod.py").write_text(body)
+        findings, _ = run_lint(root, [])
+        return findings
+
+    def test_fsync_on_one_branch_only_is_a_finding(self, tmp_path):
+        findings = self._lint_snippet(
+            tmp_path,
+            "import os\n"
+            "from x import faults\n"
+            "def persist(path, data, fast):\n"
+            "    tmp = path + '.tmp'\n"
+            "    with open(tmp, 'wb') as f:\n"
+            "        f.write(data)\n"
+            "        if not fast:\n"
+            "            os.fsync(f.fileno())\n"
+            "        faults.inject_write('x.write', tmp)\n"
+            "    os.replace(tmp, path)\n",
+        )
+        assert len(findings) == 1
+        assert "fsync on every path" in findings[0].message
+
+    def test_fsync_on_both_branches_is_clean(self, tmp_path):
+        findings = self._lint_snippet(
+            tmp_path,
+            "import os\n"
+            "from x import faults\n"
+            "def persist(path, data, fast):\n"
+            "    tmp = path + '.tmp'\n"
+            "    with open(tmp, 'wb') as f:\n"
+            "        f.write(data)\n"
+            "        if not fast:\n"
+            "            os.fsync(f.fileno())\n"
+            "        else:\n"
+            "            os.fsync(f.fileno())\n"
+            "        faults.inject_write('x.write', tmp)\n"
+            "    os.replace(tmp, path)\n",
+        )
+        assert findings == []
+
+    def test_helper_dominates_instead_of_inline_fsync(self, tmp_path):
+        findings = self._lint_snippet(
+            tmp_path,
+            "import os\n"
+            "def promote(staging, final, tmp, name):\n"
+            "    with open(tmp, 'wb') as f:\n"
+            "        f.write(b'x')\n"
+            "    _commit_tmp(tmp, name)\n"
+            "    os.rename(staging, final)\n",
+        )
+        assert findings == []
+
+
+class TestFencingFlow:
+    def _lint_snippet(self, tmp_path, body):
+        root = _mini_project(tmp_path, "fencing-discipline", [])
+        (tmp_path / "mod.py").write_text(body)
+        findings, _ = run_lint(root, [])
+        return findings
+
+    def test_fenced_constant_resolved_across_files(self, tmp_path):
+        """The prefix constant lives in one module, the raw put in
+        another — project_wide scope must still connect them."""
+        root = _mini_project(tmp_path, "fencing-discipline", [])
+        (tmp_path / "consts.py").write_text('JOB_INDEX_PREFIX = "jobs/"\n')
+        (tmp_path / "mod.py").write_text(
+            "from consts import JOB_INDEX_PREFIX\n"
+            "def clobber(store, jid, data):\n"
+            "    store.put(JOB_INDEX_PREFIX + jid, data)\n"
+        )
+        findings, _ = run_lint(root, [])
+        assert len(findings) == 1
+        assert "fenced namespace written" in findings[0].message
+
+    def test_token_read_in_loop_body_dominates(self, tmp_path):
+        findings = self._lint_snippet(
+            tmp_path,
+            "def publish(store, mgr, items):\n"
+            "    for key, data in items:\n"
+            "        lease = mgr.lease()\n"
+            "        store.put_fenced(key, data, lease)\n",
+        )
+        assert findings == []
+
+    def test_token_read_before_loop_is_stale_by_iteration_two(
+        self, tmp_path
+    ):
+        """A pre-loop read does still dominate in the CFG sense — the
+        rule accepts it. Pin the boundary so a future tightening is a
+        conscious choice, not drift."""
+        findings = self._lint_snippet(
+            tmp_path,
+            "def publish(store, mgr, items):\n"
+            "    lease = mgr.lease()\n"
+            "    for key, data in items:\n"
+            "        store.put_fenced(key, data, lease)\n",
+        )
+        assert findings == []
+
+
+class TestSarifOutput:
+    def test_document_shape(self):
+        findings = [
+            Finding(
+                "atomic-commit",
+                "GL013",
+                "spark_examples_tpu/store/local.py",
+                42,
+                "test message",
+            )
+        ]
+        doc = sarif_document(findings)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "graftlint"
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {"GL013", "GL014", "GL015"} <= rule_ids
+        (result,) = run["results"]
+        assert result["ruleId"] == "GL013"
+        loc = result["locations"][0]["physicalLocation"]
+        assert (
+            loc["artifactLocation"]["uri"]
+            == "spark_examples_tpu/store/local.py"
+        )
+        assert loc["region"]["startLine"] == 42
+
+    def test_cli_emits_parseable_sarif(self, tmp_path):
+        root = _mini_project(
+            tmp_path, "atomic-commit", ["gl013_positive.py"]
+        )
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "tools.graftlint",
+                "--root",
+                root,
+                "--format",
+                "sarif",
+            ],
+            capture_output=True,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 1
+        doc = json.loads(proc.stdout)
+        assert doc["runs"][0]["results"], "positive fixture must surface"
+
+
+class TestRealTree:
+    def test_real_tree_is_clean_under_the_durability_rules(self):
+        """The acceptance bar: the same blocking invocation CI runs,
+        narrowed to the new rules' scopes, exits 0 on this tree."""
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "tools.graftlint",
+                "spark_examples_tpu/store",
+                "spark_examples_tpu/serving",
+                "spark_examples_tpu/genomics/mirror.py",
+                "spark_examples_tpu/obs/flightrec.py",
+            ],
+            capture_output=True,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    @pytest.mark.parametrize(
+        "code,name",
+        [
+            ("GL013", "atomic-commit"),
+            ("GL014", "fencing-discipline"),
+            ("GL015", "journal-compat"),
+        ],
+    )
+    def test_rules_registered(self, code, name):
+        by_code = {r.code: r.name for r in ALL_RULES}
+        assert by_code[code] == name

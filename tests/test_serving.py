@@ -388,6 +388,70 @@ class TestJobJournal:
         finally:
             tier.close()
 
+    def test_registry_generated_mixed_version_replay_pins_bytes(
+        self, tmp_path, served_source
+    ):
+        """Round 19 gate: the mixed-version journal is GENERATED from
+        the GL015 key registry (``journal_schema``) instead of
+        hand-typed literals — if the registry and the reader drift,
+        this test and the static rule fail together. Covers the
+        round-6 shape, the round-17 replicated submit (replica +
+        fence), and the round-18 sketch-mode submit, and pins replay
+        byte-identity: replaying never rewrites the journal file, and
+        every value folds back verbatim."""
+        from spark_examples_tpu.serving import journal_schema as js
+
+        src, base, _ = served_source
+        d = str(tmp_path / "j")
+        events = [
+            {"e": "submit", "id": "old", "seq": 1, "key": "k-old",
+             "spec": {"tenant": "t"}, "ts": 1.0},
+            {"e": "start", "id": "old"},
+            {"e": "fail", "id": "old", "error": "worker lost"},
+            # Round 17: replica identity + fencing token on the submit.
+            {"e": "submit", "id": "replicated", "seq": 2, "key": "k-re",
+             "spec": {"tenant": "t"}, "ts": 2.0, "trace": "t-re",
+             "replica": "r-host-1", "fence": 3},
+            # Round 18: million-sample cohorts submit sketch-mode PCA.
+            {"e": "submit", "id": "sketchy", "seq": 3, "key": "k-sk",
+             "spec": {"tenant": "t", "pca_mode": "sketch"}, "ts": 3.0,
+             "trace": "t-sk"},
+        ]
+        for ev in events:
+            assert ev["e"] in js.JOURNAL_EVENT_KINDS
+            assert set(ev) <= js.JOURNAL_KEYS
+            required = (
+                js.JOURNAL_REQUIRED_KEYS
+                if ev["e"] == "submit"
+                else {"e", "id"}
+            )
+            assert required <= set(ev)
+        j = JobJournal(d)
+        for ev in events:
+            j.append(ev)
+        j.close()
+
+        path = os.path.join(d, "jobs.journal.jsonl")
+        with open(path, "rb") as f:
+            raw_before = f.read()
+        assert list(JobJournal.replay_events(d)) == events
+        with open(path, "rb") as f:
+            assert f.read() == raw_before, "replay must never rewrite"
+
+        tier = AnalysisJobTier(
+            AnalysisEngine(src), base, workers=0, journal_dir=d
+        )
+        try:
+            by_id = {job.id: job for job in tier.jobs()}
+            assert by_id["old"].state == "failed"
+            assert by_id["old"].error == "worker lost"
+            assert by_id["replicated"].state == "queued"
+            assert by_id["replicated"].trace_id == "t-re"
+            assert by_id["sketchy"].state == "queued"
+            assert by_id["sketchy"].spec.pca_mode == "sketch"
+        finally:
+            tier.close()
+
 
 class TestTierExecution:
     def test_job_matches_batch_driver_bit_identical(self, served_source):

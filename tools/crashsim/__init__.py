@@ -1,0 +1,51 @@
+"""crashsim — ALICE-style crash-consistency checking for the repo's
+persistence surfaces.
+
+The deterministic chaos suite kills processes at the torn-write seams
+(``faults.inject_write``) — one crash point per seam, chosen by hand.
+crashsim inverts that: it RECORDS the file-system operation sequence a
+real workload performs (every ``open``-for-write capture, ``os.fsync``,
+``os.rename``/``os.replace``, ``unlink``/``mkdir``/``rmdir`` under a
+scratch root), then enumerates EVERY crash prefix of that log,
+materializes each crashed state in a fresh directory under a
+pessimistic-but-legal file-system model, runs the real recovery path
+(store reload, journal replay, staging reuse, lease reacquire, delta
+reload, flight-record parse), and asserts the pinned invariants:
+
+- **committed-value-survives** — once the atomic rename is in the
+  prefix, recovery sees the committed value, whole;
+- **no-partial-visible** — a file visible under its final name is
+  never torn (the fsync-before-rename order made durable what the
+  rename published);
+- **replay byte-identity** — journal replay of a crashed log is a
+  prefix of the appended events and is stable across re-replays;
+- **fencing floor monotone** — a lease doc is never torn, so the
+  token floor survives every crash.
+
+The model (``tools/crashsim/model.py``) is deliberately conservative
+in the direction that finds bugs: unfsynced ("volatile") content
+propagates THROUGH renames — a rename publishes whatever the data
+pages happen to hold, which is exactly how a missing
+fsync-before-rename surfaces a torn file under a committed name (the
+ALICE "All File Systems Are Not Created Equal" failure class, OSDI
+'14). Renames, unlinks, and mkdirs are treated as ordered and durable
+(ext4-ordered journaling); per crash prefix, torn variants are
+enumerated for the most-recently-written volatile file and the
+contiguous-tail-truncation model stands in for arbitrary page
+reordering. ``os.open``-level I/O (directory fsyncs, mutex lock dirs'
+mtimes) is below the interposition layer; both limits are documented
+in docs/STATIC_ANALYSIS.md.
+
+Run it: ``python -m tools.crashsim`` (``--list`` for scenarios,
+``--out`` for a JSONL report, exit 1 on any violation).
+"""
+
+from tools.crashsim.model import CrashState, enumerate_crash_states
+from tools.crashsim.recorder import FsOp, OpRecorder
+
+__all__ = [
+    "CrashState",
+    "FsOp",
+    "OpRecorder",
+    "enumerate_crash_states",
+]

@@ -1,0 +1,161 @@
+"""Run scenarios: record once, crash everywhere, recover every state.
+
+``run_scenario`` is the whole loop: run the workload under the
+recorder in a ``work/`` directory, enumerate every crash state from
+the op log, materialize each into its own ``crash-<n>-<variant>/``
+directory, and run the scenario's check (which exercises the REAL
+recovery code) against it. Checks also run against the live post-
+workload tree — the zero-crash case must obviously pass too, and a
+check that fails there is a broken check, not a durability bug.
+
+A check raising is itself a violation: recovery code that throws on a
+legal crashed state is exactly the failure the harness exists to find
+(the pre-round-19 flight recorder would have failed this way — a torn
+dump raising ``json.JSONDecodeError`` in the reader).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import traceback
+from dataclasses import dataclass
+from typing import IO, List, Optional
+
+from tools.crashsim.model import (
+    CrashInfo,
+    enumerate_crash_states,
+    materialize,
+)
+from tools.crashsim.recorder import OpRecorder
+from tools.crashsim.scenarios import Scenario
+
+
+@dataclass(frozen=True)
+class Violation:
+    scenario: str
+    n_ops: int
+    variant: str
+    focus: Optional[str]
+    message: str
+
+
+@dataclass
+class ScenarioResult:
+    scenario: str
+    n_ops: int
+    n_states: int
+    violations: List[Violation]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def run_scenario(
+    scenario: Scenario, workdir: str, keep_failures: bool = False
+) -> ScenarioResult:
+    """Record ``scenario`` under ``workdir`` and check every crashed
+    state. Crashed-state directories are deleted as they pass; with
+    ``keep_failures`` the violating ones stay on disk for autopsy."""
+    os.makedirs(workdir, exist_ok=True)
+    live_root = os.path.join(workdir, "work")
+    os.makedirs(live_root)
+    recorder = OpRecorder(live_root)
+    with recorder:
+        scenario.workload(live_root)
+
+    violations: List[Violation] = []
+    full_info = CrashInfo(ops=list(recorder.ops), variant="full")
+    live_msg = _run_check(scenario, live_root, full_info)
+    if live_msg is not None:
+        violations.append(
+            Violation(scenario.name, len(recorder.ops), "live", None,
+                      f"check fails on the UNCRASHED tree: {live_msg}")
+        )
+
+    n_states = 0
+    for state in enumerate_crash_states(recorder.ops):
+        n_states += 1
+        dest = os.path.join(
+            workdir, f"crash-{state.n_ops:03d}-{state.variant}"
+        )
+        materialize(state, dest)
+        info = CrashInfo(
+            ops=list(recorder.ops[: state.n_ops]),
+            variant=state.variant,
+            focus=state.focus,
+        )
+        msg = _run_check(scenario, dest, info)
+        if msg is not None:
+            violations.append(
+                Violation(
+                    scenario.name, state.n_ops, state.variant,
+                    state.focus, msg,
+                )
+            )
+            if keep_failures:
+                continue
+        shutil.rmtree(dest, ignore_errors=True)
+    return ScenarioResult(
+        scenario=scenario.name,
+        n_ops=len(recorder.ops),
+        n_states=n_states,
+        violations=violations,
+    )
+
+
+def _run_check(
+    scenario: Scenario, root: str, info: CrashInfo
+) -> Optional[str]:
+    try:
+        return scenario.check(root, info)
+    except Exception:  # noqa: BLE001 - a throwing recovery IS the finding
+        tail = traceback.format_exc().strip().splitlines()[-1]
+        return f"recovery raised on a legal crashed state: {tail}"
+
+
+def write_report(
+    results: List[ScenarioResult], stream: IO[str]
+) -> None:
+    """One JSONL line per scenario plus one per violation — the same
+    shape the graftlint CI legs tee into their artifacts."""
+    for res in results:
+        stream.write(
+            json.dumps(
+                {
+                    "kind": "scenario",
+                    "scenario": res.scenario,
+                    "ops": res.n_ops,
+                    "states": res.n_states,
+                    "violations": len(res.violations),
+                    "ok": res.ok,
+                },
+                sort_keys=True,
+            )
+            + "\n"
+        )
+        for v in res.violations:
+            stream.write(
+                json.dumps(
+                    {
+                        "kind": "violation",
+                        "scenario": v.scenario,
+                        "crash_ops": v.n_ops,
+                        "variant": v.variant,
+                        "focus": v.focus,
+                        "message": v.message,
+                    },
+                    sort_keys=True,
+                )
+                + "\n"
+            )
+
+
+__all__ = [
+    "ScenarioResult",
+    "Violation",
+    "run_scenario",
+    "write_report",
+]

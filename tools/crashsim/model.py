@@ -1,0 +1,229 @@
+"""The crashed-file-system model: prefixes of the op log → on-disk states.
+
+Replaying a prefix of the recorded operations yields, per file, the
+pair (latest content image, latest DURABLE image). The durable image
+advances only at ``fsync``; metadata operations (``rename``,
+``unlink``, ``mkdir``, ``rmdir``) are applied in order and assumed
+durable — the ext4-ordered-journaling behavior the repo's commit
+protocol is written against. The model's one deliberate pessimism is
+the ALICE failure class: a rename moves the FILE, not a guarantee —
+if the source was never fsynced, the crashed state can expose a torn
+image under the DESTINATION name. That is precisely the bug shape a
+missing fsync-before-rename creates, and the harness's planted-bug
+test proves the model catches it.
+
+Variant enumeration is bounded: for each crash prefix, the
+most-recently-written still-volatile file gets three materializations
+— ``full`` (every page made it), ``torn`` (durable floor plus half
+the unsynced tail, the contiguous-truncation model), and ``floor``
+(only what was fsynced; absent if nothing ever was). Other volatile
+files materialize full — a legal (optimistic) outcome that keeps the
+state count linear in the op count; the per-file variants still visit
+every commit point because every prefix boundary makes each write the
+"most recent" one somewhere in the enumeration.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.crashsim.recorder import FsOp
+
+VARIANTS = ("full", "torn", "floor")
+
+
+@dataclass
+class _FileState:
+    content: bytes = b""
+    durable: Optional[bytes] = None  # None = never fsynced
+    last_write_idx: int = -1
+
+    @property
+    def volatile(self) -> bool:
+        return self.durable != self.content
+
+
+@dataclass(frozen=True)
+class CrashState:
+    """One materializable crashed state: every op in ``ops[:n_ops]``
+    happened, then the process died; ``variant`` picks the fate of the
+    most-recently-written volatile file (``focus``)."""
+
+    n_ops: int
+    variant: str
+    focus: Optional[str]
+    files: Tuple[Tuple[str, bytes], ...]
+    dirs: Tuple[str, ...]
+
+    def describe(self) -> str:
+        focus = f" focus={self.focus}" if self.focus else ""
+        return f"crash@{self.n_ops}/{self.variant}{focus}"
+
+
+def _move_prefix(
+    table: Dict[str, _FileState], src: str, dst: str
+) -> None:
+    """Directory rename: move every entry under ``src/`` to ``dst/``."""
+    prefix = src + "/"
+    moved = [k for k in table if k == src or k.startswith(prefix)]
+    for k in moved:
+        new_key = dst + k[len(src):]
+        table[new_key] = table.pop(k)
+
+
+def _replay_prefix(
+    ops: List[FsOp], n: int
+) -> Tuple[Dict[str, _FileState], Set[str]]:
+    files: Dict[str, _FileState] = {}
+    dirs: Set[str] = set()
+    for idx, op in enumerate(ops[:n]):
+        if op.kind == "write":
+            st = files.setdefault(op.path, _FileState())
+            st.content = op.content or b""
+            st.last_write_idx = idx
+        elif op.kind == "fsync":
+            st = files.get(op.path)
+            if st is not None:
+                st.durable = st.content
+        elif op.kind == "rename":
+            assert op.dst is not None
+            if op.path in files:
+                files[op.dst] = files.pop(op.path)
+            else:
+                # Directory rename (or a file the recorder never saw a
+                # write for): move the subtree.
+                _move_prefix(files, op.path, op.dst)
+                moved_dirs = {
+                    d
+                    for d in dirs
+                    if d == op.path or d.startswith(op.path + "/")
+                }
+                for d in moved_dirs:
+                    dirs.discard(d)
+                    dirs.add(op.dst + d[len(op.path):])
+        elif op.kind == "unlink":
+            files.pop(op.path, None)
+        elif op.kind == "mkdir":
+            dirs.add(op.path)
+        elif op.kind == "rmdir":
+            dirs.discard(op.path)
+    return files, dirs
+
+
+def _torn(st: _FileState) -> bytes:
+    floor = st.durable or b""
+    tail = st.content[len(floor):]
+    if not tail:
+        # Shrinking/rewriting file: torn = half of the full image.
+        return st.content[: max(0, len(st.content) // 2)]
+    return floor + tail[: len(tail) // 2]
+
+
+def enumerate_crash_states(ops: List[FsOp]) -> Iterator[CrashState]:
+    """Every (prefix, variant) crashed state, deduplicated: prefixes
+    whose materialized image is identical to an already-yielded one
+    (e.g. consecutive metadata ops on paths that do not change file
+    fates) still yield — the check is cheap and keeping the mapping
+    prefix→state 1:1 makes violations easy to localize."""
+    for n in range(len(ops) + 1):
+        files, dirs = _replay_prefix(ops, n)
+        focus: Optional[str] = None
+        focus_idx = -1
+        for path, st in files.items():
+            if st.volatile and st.last_write_idx > focus_idx:
+                focus = path
+                focus_idx = st.last_write_idx
+        variants = VARIANTS if focus is not None else ("full",)
+        for variant in variants:
+            out: List[Tuple[str, bytes]] = []
+            for path, st in sorted(files.items()):
+                if path == focus:
+                    if variant == "torn":
+                        out.append((path, _torn(st)))
+                    elif variant == "floor":
+                        if st.durable is not None:
+                            out.append((path, st.durable))
+                        # never-synced + floor → file absent
+                    else:
+                        out.append((path, st.content))
+                else:
+                    # Non-focus files: full image (optimistic-legal).
+                    out.append((path, st.content))
+            yield CrashState(
+                n_ops=n,
+                variant=variant,
+                focus=focus,
+                files=tuple(out),
+                dirs=tuple(sorted(dirs)),
+            )
+
+
+def materialize(state: CrashState, dest: str) -> None:
+    """Write the crashed state into ``dest`` (a fresh directory).
+
+    Everything is back-dated an hour: recovery code that ages
+    artifacts by mtime (the store's stale-CAS-mutex breaker, lock-file
+    staleness) must see the crash as PAST, not as a racing live peer —
+    a freshly-materialized lock dir with a now-mtime would make
+    recovery wait out a holder that no longer exists."""
+    os.makedirs(dest, exist_ok=True)
+    stamp = time.time() - 3600.0
+    for d in state.dirs:
+        os.makedirs(os.path.join(dest, d), exist_ok=True)
+    for rel, content in state.files:
+        full = os.path.join(dest, rel)
+        os.makedirs(os.path.dirname(full) or dest, exist_ok=True)
+        with open(full, "wb") as f:
+            f.write(content)
+        os.utime(full, (stamp, stamp))
+    for d in sorted(state.dirs, reverse=True):
+        try:
+            os.utime(os.path.join(dest, d), (stamp, stamp))
+        except OSError:
+            pass
+
+
+@dataclass
+class CrashInfo:
+    """What the recovery check may know about the crash: the op prefix
+    (the ground truth of what HAPPENED before the lights went out) and
+    the variant chosen for the focus file."""
+
+    ops: List[FsOp] = field(default_factory=list)
+    variant: str = "full"
+    focus: Optional[str] = None
+
+    def renames_to(self, suffix: str) -> int:
+        return sum(
+            1
+            for op in self.ops
+            if op.kind == "rename"
+            and op.dst is not None
+            and op.dst.endswith(suffix)
+        )
+
+    def fsyncs_of(self, suffix: str) -> int:
+        return sum(
+            1
+            for op in self.ops
+            if op.kind == "fsync" and op.path.endswith(suffix)
+        )
+
+    def writes_of(self, suffix: str) -> List[bytes]:
+        return [
+            op.content or b""
+            for op in self.ops
+            if op.kind == "write" and op.path.endswith(suffix)
+        ]
+
+
+__all__ = [
+    "VARIANTS",
+    "CrashState",
+    "CrashInfo",
+    "enumerate_crash_states",
+    "materialize",
+]

@@ -1,0 +1,226 @@
+"""File-system operation recording for crash-prefix enumeration.
+
+:class:`OpRecorder` is a context manager that patches ``builtins.open``
+and the ``os``-level metadata operations for the duration of a
+workload, recording every durability-relevant operation on paths under
+one scratch root. Python cannot interpose on libc ``write(2)`` without
+a C shim, so writes are captured as FULL-FILE IMAGES at the moments
+the page cache state is knowable from userspace: ``flush()``,
+``close()``, and ``os.fsync(fd)``. That granularity is exactly the
+granularity the repo's own commit discipline exposes — every persisted
+write flushes before it fsyncs and fsyncs before it renames — and it
+keeps the op log small enough to enumerate every prefix.
+
+Only paths under ``root`` are recorded; everything else (imports,
+telemetry, the test harness's own files) passes straight through to
+the real functions. The recorder is process-global while active
+(``builtins.open`` has no narrower scope), so it is NOT reentrant and
+not thread-safe against concurrent recorders — one workload at a time,
+which is what the harness does.
+"""
+
+from __future__ import annotations
+
+import builtins
+import os
+from dataclasses import dataclass
+from typing import IO, Any, Callable, Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class FsOp:
+    """One recorded operation. ``kind`` is ``write``/``fsync``/
+    ``rename``/``unlink``/``mkdir``/``rmdir``; paths are relative to
+    the recorder root; ``content`` is the full file image for
+    ``write`` ops (None otherwise); ``dst`` is set for ``rename``."""
+
+    kind: str
+    path: str
+    content: Optional[bytes] = None
+    dst: Optional[str] = None
+
+
+_WRITE_MODE_CHARS = ("w", "a", "x", "+")
+
+
+def _is_write_mode(mode: str) -> bool:
+    return any(c in mode for c in _WRITE_MODE_CHARS)
+
+
+class _RecordingFile:
+    """Forwarding proxy around a real file object that snapshots the
+    on-disk image at every flush/close (after forwarding the call, so
+    the snapshot reads what the OS actually has)."""
+
+    def __init__(self, recorder: "OpRecorder", f: IO[Any], path: str):
+        self._recorder = recorder
+        self._f = f
+        self._path = path
+
+    # -- the capture points ----------------------------------------------------
+
+    def flush(self) -> None:
+        self._f.flush()
+        self._recorder._capture(self._path)
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._recorder._capture(self._path)
+        self._recorder._forget_fd(self)
+        self._f.close()
+
+    # -- plumbing --------------------------------------------------------------
+
+    def __enter__(self) -> "_RecordingFile":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def __iter__(self) -> Any:
+        return iter(self._f)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._f, name)
+
+
+class OpRecorder:
+    """Record durability-relevant fs ops under ``root`` while active."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+        self.ops: List[FsOp] = []
+        self._last_image: Dict[str, bytes] = {}
+        self._open_files: Dict[int, _RecordingFile] = {}
+        self._orig: Dict[str, Callable[..., Any]] = {}
+        self._active = False
+
+    # -- path helpers ----------------------------------------------------------
+
+    def _rel(self, path: Any) -> Optional[str]:
+        """Repo-root-relative path when under the recorder root, else
+        None (op not recorded)."""
+        try:
+            abspath = os.path.abspath(os.fspath(path))
+        except TypeError:
+            return None  # fd-based or path-like we can't resolve
+        if abspath == self.root:
+            return "."
+        if not abspath.startswith(self.root + os.sep):
+            return None
+        return os.path.relpath(abspath, self.root)
+
+    # -- capture ---------------------------------------------------------------
+
+    def _capture(self, rel: str) -> None:
+        """Snapshot the current on-disk image of ``rel`` and record a
+        write op if it changed since the last snapshot."""
+        full = os.path.join(self.root, rel)
+        try:
+            with self._orig["open"](full, "rb") as f:  # type: ignore[no-any-return]
+                content = f.read()
+        except OSError:
+            return
+        if self._last_image.get(rel) == content:
+            return
+        self._last_image[rel] = content
+        self.ops.append(FsOp("write", rel, content=content))
+
+    def _forget_fd(self, proxy: _RecordingFile) -> None:
+        self._open_files = {
+            fd: p for fd, p in self._open_files.items() if p is not proxy
+        }
+
+    # -- patched entry points --------------------------------------------------
+
+    def _open(self, file: Any, mode: str = "r", *args: Any, **kw: Any) -> Any:
+        f = self._orig["open"](file, mode, *args, **kw)
+        rel = self._rel(file) if isinstance(mode, str) else None
+        if rel is None or not _is_write_mode(mode):
+            return f
+        proxy = _RecordingFile(self, f, rel)
+        try:
+            self._open_files[f.fileno()] = proxy
+        except (OSError, ValueError):
+            pass
+        return proxy
+
+    def _fsync(self, fd: int) -> None:
+        self._orig["os.fsync"](fd)
+        proxy = self._open_files.get(fd)
+        if proxy is not None:
+            self._capture(proxy._path)
+            self.ops.append(FsOp("fsync", proxy._path))
+
+    def _rename_like(self, name: str) -> Callable[..., Any]:
+        orig = self._orig[name]
+
+        def patched(src: Any, dst: Any, **kw: Any) -> Any:
+            result = orig(src, dst, **kw)
+            rel_src, rel_dst = self._rel(src), self._rel(dst)
+            if rel_src is not None and rel_dst is not None:
+                self.ops.append(FsOp("rename", rel_src, dst=rel_dst))
+                # The image (and its durability) travels with the file.
+                if rel_src in self._last_image:
+                    self._last_image[rel_dst] = self._last_image.pop(
+                        rel_src
+                    )
+            return result
+
+        return patched
+
+    def _meta(self, name: str, kind: str) -> Callable[..., Any]:
+        orig = self._orig[name]
+
+        def patched(path: Any, *args: Any, **kw: Any) -> Any:
+            result = orig(path, *args, **kw)
+            rel = self._rel(path)
+            if rel is not None:
+                self.ops.append(FsOp(kind, rel))
+                if kind == "unlink":
+                    self._last_image.pop(rel, None)
+            return result
+
+        return patched
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def __enter__(self) -> "OpRecorder":
+        if self._active:
+            raise RuntimeError("OpRecorder is not reentrant")
+        self._active = True
+        self._orig = {
+            "open": builtins.open,
+            "os.fsync": os.fsync,
+            "os.rename": os.rename,
+            "os.replace": os.replace,
+            "os.unlink": os.unlink,
+            "os.remove": os.remove,
+            "os.mkdir": os.mkdir,
+            "os.rmdir": os.rmdir,
+        }
+        builtins.open = self._open  # type: ignore[assignment]
+        os.fsync = self._fsync  # type: ignore[assignment]
+        os.rename = self._rename_like("os.rename")  # type: ignore[assignment]
+        os.replace = self._rename_like("os.replace")  # type: ignore[assignment]
+        os.unlink = self._meta("os.unlink", "unlink")  # type: ignore[assignment]
+        os.remove = self._meta("os.remove", "unlink")  # type: ignore[assignment]
+        os.mkdir = self._meta("os.mkdir", "mkdir")  # type: ignore[assignment]
+        os.rmdir = self._meta("os.rmdir", "rmdir")  # type: ignore[assignment]
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        builtins.open = self._orig["open"]  # type: ignore[assignment]
+        os.fsync = self._orig["os.fsync"]  # type: ignore[assignment]
+        os.rename = self._orig["os.rename"]  # type: ignore[assignment]
+        os.replace = self._orig["os.replace"]  # type: ignore[assignment]
+        os.unlink = self._orig["os.unlink"]  # type: ignore[assignment]
+        os.remove = self._orig["os.remove"]  # type: ignore[assignment]
+        os.mkdir = self._orig["os.mkdir"]  # type: ignore[assignment]
+        os.rmdir = self._orig["os.rmdir"]  # type: ignore[assignment]
+        self._open_files.clear()
+        self._active = False
+
+
+__all__ = ["FsOp", "OpRecorder"]

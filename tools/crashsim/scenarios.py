@@ -1,0 +1,389 @@
+"""The recorded workloads and their recovery invariants.
+
+One scenario per commit discipline the repo hand-enforces: the durable
+store's blob put and lease CAS, the job journal's append+replay, the
+mirror's staging commit+promote, the delta cache's persist-dir
+write-through, and the flight recorder's dump. Each scenario is a
+(workload, check) pair: the workload runs ONCE under the
+:class:`~tools.crashsim.recorder.OpRecorder`; the check runs once per
+enumerated crashed state, against a directory materialized by the
+model, and returns a violation message or None. Checks run the REAL
+recovery code — ``LocalDirStore`` reads, ``JobJournal.replay_events``,
+``DeltaIndex``'s load-and-sweep, a fresh ``lease_acquire`` — because
+the invariant is about what recovery DOES, not about what the bytes
+look like.
+
+Workloads draw journal events from the GL015 registry
+(``serving/journal_schema.py``): the static rule, the mixed-version
+replay test, and this harness must all describe the same records.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tools.crashsim.model import CrashInfo
+
+Check = Callable[[str, CrashInfo], Optional[str]]
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    summary: str
+    workload: Callable[[str], None]
+    check: Check
+
+
+# -- store blob put ----------------------------------------------------------
+
+_V1 = b"value-one" * 13
+_V2 = b"value-two!" * 17
+
+
+def _store_put_workload(root: str) -> None:
+    from spark_examples_tpu.store import LocalDirStore
+
+    store = LocalDirStore(root)
+    store.put("jobs/a", _V1)
+    store.put("jobs/a", _V2)
+
+
+def _store_put_check(root: str, info: CrashInfo) -> Optional[str]:
+    from spark_examples_tpu.store import LocalDirStore, StoreCorruptError
+
+    store = LocalDirStore(root)
+    commits = info.renames_to("objects/jobs/a")
+    try:
+        data: Optional[bytes] = store.get("jobs/a")
+    except KeyError:
+        data = None
+    except StoreCorruptError as e:
+        return f"torn blob visible under committed name: {e}"
+    if commits == 0:
+        if data is not None:
+            return "uncommitted value visible before any rename"
+    elif commits == 1:
+        if data != _V1:
+            return "committed v1 lost or mutated after its rename"
+    else:
+        if data != _V2:
+            return "committed v2 lost or mutated after its rename"
+    return None
+
+
+# -- store lease CAS ---------------------------------------------------------
+
+
+def _lease_workload(root: str) -> None:
+    from spark_examples_tpu.store import LocalDirStore
+
+    clock_now = [1000.0]
+    store = LocalDirStore(root, clock=lambda: clock_now[0])
+    lease = store.lease_acquire("replica-a", "owner-1", ttl_s=5.0)
+    assert lease is not None and lease.token == 1
+    clock_now[0] += 60.0  # owner-1 expires: takeover path, not release
+    lease = store.lease_acquire("replica-a", "owner-2", ttl_s=5.0)
+    assert lease is not None and lease.token == 2
+    store.lease_renew(lease, ttl_s=5.0)
+
+
+def _lease_check(root: str, info: CrashInfo) -> Optional[str]:
+    from spark_examples_tpu.store import LocalDirStore
+
+    doc_path = os.path.join(root, "leases", "replica-a.json")
+    visible_token = 0
+    if os.path.exists(doc_path):
+        try:
+            with open(doc_path, "rb") as f:
+                doc = json.loads(f.read().decode("utf-8"))
+            visible_token = int(doc["token"])
+        except (ValueError, KeyError) as e:
+            # THE fencing-floor invariant: a torn doc reads as "no
+            # lease", which resets the token floor — fsync-before-
+            # rename is what makes this state unreachable.
+            return f"lease doc torn under committed name: {e}"
+    commits = info.renames_to("leases/replica-a.json")
+    expected = {0: 0, 1: 1, 2: 2, 3: 2}.get(commits, 2)
+    if visible_token != expected:
+        return (
+            f"lease token {visible_token} visible after {commits} "
+            f"committed CAS rename(s); expected {expected}"
+        )
+    # Recovery: every lease long-expired (workload clock was synthetic
+    # epoch-1000); a fresh acquire must land STRICTLY above the floor.
+    store = LocalDirStore(root)
+    got = store.lease_acquire("replica-a", "owner-recover", ttl_s=5.0)
+    if got is None:
+        return "post-crash lease acquire rejected by an expired holder"
+    if got.token <= visible_token:
+        return (
+            f"fencing floor regressed: reacquired token {got.token} "
+            f"<= visible committed token {visible_token}"
+        )
+    return None
+
+
+# -- journal append ----------------------------------------------------------
+
+
+def _journal_events() -> List[Dict[str, object]]:
+    """Registry-shaped events — the same keys GL015 checks writers
+    against. Kept import-light: the registry is data, not machinery."""
+    from spark_examples_tpu.serving import journal_schema as js
+
+    spec = {"kind": "pca", "tenant": "t0", "num_pc": 2}
+    events: List[Dict[str, object]] = [
+        {
+            "e": "submit",
+            "id": "job-1",
+            "seq": 1,
+            "key": "cohort-1",
+            "spec": spec,
+            "ts": 1000.0,
+            "trace": "trace-1",
+        },
+        {"e": "start", "id": "job-1"},
+        {"e": "done", "id": "job-1", "rows": 3},
+        {
+            "e": "submit",
+            "id": "job-2",
+            "seq": 2,
+            "key": "cohort-2",
+            "spec": spec,
+            "ts": 1001.0,
+            "trace": "trace-2",
+            "replica": "r-1",
+            "fence": 4,
+        },
+    ]
+    for ev in events:
+        assert ev["e"] in js.JOURNAL_EVENT_KINDS
+        assert set(ev) <= js.JOURNAL_KEYS
+    return events
+
+
+def _journal_workload(root: str) -> None:
+    from spark_examples_tpu.serving.jobs import JobJournal
+
+    events = _journal_events()
+    journal = JobJournal(root)
+    try:
+        journal.append(events[0])
+        journal.append(events[1])
+        journal.flush()  # fsync: events 0-1 are the durable floor
+        journal.append(events[2])
+        journal.append(events[3])
+    finally:
+        journal.close()
+
+
+def _journal_check(root: str, info: CrashInfo) -> Optional[str]:
+    from spark_examples_tpu.serving.jobs import JobJournal
+
+    expected = _journal_events()
+    got = list(JobJournal.replay_events(root))
+    if got != expected[: len(got)]:
+        return (
+            f"replay is not a prefix of the appended events: got "
+            f"{len(got)} event(s), first divergence at "
+            f"{next(i for i, (a, b) in enumerate(zip(got, expected)) if a != b)}"
+        )
+    if info.fsyncs_of("journal.jsonl") >= 1 and len(got) < 2:
+        return (
+            f"durable floor lost: the pre-crash flush() fsynced events "
+            f"0-1 but replay recovered only {len(got)}"
+        )
+    again = list(JobJournal.replay_events(root))
+    if again != got:
+        return "replay is not byte-identical across re-replays"
+    return None
+
+
+# -- mirror staging ----------------------------------------------------------
+
+_MIRROR_FILES: Tuple[Tuple[str, bytes], ...] = (
+    ("variants.avro", b"A" * 307),
+    ("callsets.avro", b"B" * 211),
+)
+
+
+def _mirror_workload(root: str) -> None:
+    from spark_examples_tpu.genomics.mirror import _commit_tmp, _fsync_dir
+
+    staging = os.path.join(root, "staging")
+    final = os.path.join(root, "mirror")
+    os.makedirs(staging)
+    for name, content in _MIRROR_FILES:
+        tmp = os.path.join(staging, f"{name}.tmp-{os.getpid()}")
+        with open(tmp, "wb") as f:
+            f.write(content)
+        _commit_tmp(tmp, os.path.join(staging, name))
+    os.rename(staging, final)  # the atomic promote
+    _fsync_dir(root)
+
+
+def _mirror_check(root: str, info: CrashInfo) -> Optional[str]:
+    expected = dict(_MIRROR_FILES)
+    for sub in ("mirror", "staging"):
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for name in os.listdir(base):
+            if ".tmp-" in name:
+                continue  # partials under tmp names are never trusted
+            with open(os.path.join(base, name), "rb") as f:
+                content = f.read()
+            if content != expected.get(name):
+                return (
+                    f"{sub}/{name} visible under its committed name "
+                    f"with {len(content)} byte(s) instead of "
+                    f"{len(expected.get(name, b''))} — partial commit"
+                )
+    if info.renames_to("mirror"):
+        base = os.path.join(root, "mirror")
+        if not os.path.isdir(base):
+            return "promoted mirror directory missing after its rename"
+        names = {n for n in os.listdir(base) if ".tmp-" not in n}
+        if names != set(expected):
+            return (
+                f"promoted mirror incomplete: {sorted(names)} != "
+                f"{sorted(expected)}"
+            )
+    return None
+
+
+# -- delta persist -----------------------------------------------------------
+
+_DELTA_BASE_KEY = "basekey-0123456789abcdef"
+_DELTA_SAMPLES = ("HG00096", "HG00097")
+
+
+def _delta_g() -> np.ndarray:
+    rng = np.random.RandomState(7)
+    g = rng.standard_normal((4, 4)).astype(np.float32)
+    return (g + g.T).astype(np.float32)
+
+
+def _delta_workload(root: str) -> None:
+    from spark_examples_tpu.serving.deltas import DeltaIndex
+
+    index = DeltaIndex(persist_dir=os.path.join(root, "deltas"))
+    index.put(_DELTA_BASE_KEY, _DELTA_SAMPLES, _delta_g())
+
+
+def _delta_check(root: str, info: CrashInfo) -> Optional[str]:
+    from spark_examples_tpu.serving.deltas import DeltaIndex
+
+    pdir = os.path.join(root, "deltas")
+    index = DeltaIndex(persist_dir=pdir)  # startup load sweeps partials
+    n = len(index)
+    if n not in (0, 1):
+        return f"delta reload produced {n} entries from one persist"
+    committed = info.renames_to(".npz")
+    if committed and n != 1:
+        return "committed delta entry lost: persisted rename landed " \
+            "but reload found nothing"
+    if n == 1:
+        # Reaching into the index is fine here: bit-identity of the
+        # reloaded G IS the invariant, and resolve() would re-wrap it.
+        (entry,) = index._entries.values()
+        if not entry.verify():
+            return "reloaded delta entry fails its own checksum"
+        if not np.array_equal(entry.g, _delta_g()):
+            return "reloaded delta entry is not bit-identical"
+    if os.path.isdir(pdir):
+        leftover = [x for x in os.listdir(pdir) if ".tmp-" in x]
+        if leftover:
+            return f"startup sweep left partials behind: {leftover}"
+    return None
+
+
+# -- flight recorder dump ----------------------------------------------------
+
+
+def _flightrec_workload(root: str) -> None:
+    from spark_examples_tpu.obs.flightrec import FlightRecorder
+
+    rec = FlightRecorder(capacity_per_thread=16)
+    rec.note("state", "serving.start", {"port": 1234})
+    rec.note("state", "job.running", {"id": "job-1"})
+    rec.note("signal", "SIGTERM")
+    rec.dump(os.path.join(root, "dumps", "flight.jsonl"), "crashsim")
+
+
+def _flightrec_check(root: str, info: CrashInfo) -> Optional[str]:
+    path = os.path.join(root, "dumps", "flight.jsonl")
+    if not os.path.exists(path):
+        return None  # crash before the commit: no dump is a fine dump
+    with open(path, "rb") as f:
+        lines = f.read().splitlines()
+    if not lines:
+        return "empty flight record visible under the committed name"
+    for i, raw in enumerate(lines):
+        try:
+            doc = json.loads(raw)
+        except ValueError:
+            return (
+                f"flight record torn under its committed name "
+                f"(line {i + 1} of {len(lines)} unparseable) — the "
+                "dump that exists FOR the incident is unreadable "
+                "during one"
+            )
+        if i == 0 and "schema" not in doc:
+            return "flight record first line lacks the schema header"
+    return None
+
+
+SCENARIOS: Tuple[Scenario, ...] = (
+    Scenario(
+        "store-put",
+        "LocalDirStore.put: committed blob survives whole, torn "
+        "partials only ever exist under .tmp- names",
+        _store_put_workload,
+        _store_put_check,
+    ),
+    Scenario(
+        "store-lease-cas",
+        "lease CAS: the doc is never torn, the fencing token floor "
+        "is monotone across crash + reacquire",
+        _lease_workload,
+        _lease_check,
+    ),
+    Scenario(
+        "journal-append",
+        "JobJournal: replay is a prefix of appends, the flushed floor "
+        "survives, re-replay is byte-identical",
+        _journal_workload,
+        _journal_check,
+    ),
+    Scenario(
+        "mirror-staging",
+        "mirror staging: committed files are whole, the directory "
+        "promote is atomic",
+        _mirror_workload,
+        _mirror_check,
+    ),
+    Scenario(
+        "delta-persist",
+        "delta write-through: reload sees 0 or 1 bit-identical "
+        "entries and sweeps partials",
+        _delta_workload,
+        _delta_check,
+    ),
+    Scenario(
+        "flightrec-dump",
+        "flight recorder: a dump visible under its final name always "
+        "parses",
+        _flightrec_workload,
+        _flightrec_check,
+    ),
+)
+
+
+__all__ = ["Scenario", "SCENARIOS", "Check"]

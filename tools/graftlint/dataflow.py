@@ -42,6 +42,7 @@ __all__ = [
     "class_lock_keys",
     "module_lock_keys",
     "held_at_nodes",
+    "must_events",
     "scan_calls",
     "manual_lock_ops",
     "node_scan_roots",
@@ -295,6 +296,50 @@ def held_at_nodes(
                 continue  # still unreachable
         in_states[node] = state
         new_out = transfer(node, state)
+        if out[node] != new_out:
+            out[node] = new_out
+            for s in node.succs:
+                if s not in on_list:
+                    worklist.append(s)
+                    on_list.add(s)
+    return in_states
+
+
+def must_events(
+    cfg: CFG,
+    events_at: Callable[[Node], FrozenSet[str]],
+) -> Dict[Node, FrozenSet[str]]:
+    """Forward must-EVENT dataflow: IN[node] = the event tags that have
+    occurred on EVERY path from entry to node.
+
+    The gen-only sibling of :func:`held_at_nodes` — an event that
+    happened (an ``os.fsync``, a fresh fence-token read) cannot
+    un-happen, so the transfer function only adds (meet is still
+    intersection over predecessors; unreachable predecessors are ⊤ and
+    drop out). GL013 uses it for fsync-before-rename ordering; GL014
+    for fence-token-read-dominates-write.
+    """
+    preds = cfg.preds()
+    out: Dict[Node, Optional[FrozenSet[str]]] = {n: None for n in cfg.nodes}
+    in_states: Dict[Node, FrozenSet[str]] = {}
+    worklist: List[Node] = [cfg.entry]
+    on_list = {cfg.entry}
+    while worklist:
+        node = worklist.pop()
+        on_list.discard(node)
+        if node is cfg.entry:
+            state: Optional[FrozenSet[str]] = frozenset()
+        else:
+            state = None
+            for p in preds[node]:
+                p_out = out[p]
+                if p_out is None:
+                    continue
+                state = p_out if state is None else (state & p_out)
+            if state is None:
+                continue  # unreachable so far
+        in_states[node] = state
+        new_out = state | events_at(node)
         if out[node] != new_out:
             out[node] = new_out
             for s in node.succs:

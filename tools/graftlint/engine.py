@@ -35,6 +35,7 @@ __all__ = [
     "Project",
     "load_config",
     "run_lint",
+    "sarif_document",
     "main",
 ]
 
@@ -333,6 +334,64 @@ def _in_scope(finding: Finding, rule: Any, paths: Sequence[str]) -> bool:
     )
 
 
+def sarif_document(findings: Sequence[Finding]) -> Dict[str, Any]:
+    """Findings as one SARIF 2.1.0 run — the schema GitHub code
+    scanning ingests for inline PR annotations. Rule metadata comes
+    from the live registry so every GLxxx id resolves even on a clean
+    run (an empty ``results`` array with full ``rules`` is how SARIF
+    says "checked and found nothing", not "didn't check")."""
+    from tools.graftlint.rules import ALL_RULES
+
+    rules = [
+        {
+            "id": rule.code,
+            "name": rule.name,
+            "shortDescription": {"text": rule.summary},
+        }
+        for rule in ALL_RULES
+    ]
+    results = [
+        {
+            "ruleId": f.code,
+            "level": "error",
+            "message": {"text": f"[{f.rule}] {f.message}"},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {"startLine": max(f.line, 1)},
+                    }
+                }
+            ],
+        }
+        for f in findings
+    ]
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "informationUri": (
+                            "docs/STATIC_ANALYSIS.md"
+                        ),
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
 
@@ -355,10 +414,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     parser.add_argument(
         "--format",
-        choices=("human", "jsonl"),
+        choices=("human", "jsonl", "sarif"),
         default="human",
         help="Output format (jsonl: one finding object per line plus a "
-        "trailing summary object)",
+        "trailing summary object; sarif: one SARIF 2.1.0 document for "
+        "code-scanning upload)",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="List rules and exit"
@@ -416,7 +476,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ]
     findings, suppressed = run_lint(root, rel_paths)
 
-    if args.format == "jsonl":
+    if args.format == "sarif":
+        print(json.dumps(sarif_document(findings), sort_keys=True))
+    elif args.format == "jsonl":
         for f in findings:
             print(f.jsonl())
         print(

@@ -1,0 +1,30 @@
+"""Golden negative case for GL013 atomic-commit."""
+
+import json
+import os
+
+from myproj.genomics.mirror import _commit_tmp
+from myproj.resilience import faults
+
+
+def persist_doc(path, doc):
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+        faults.inject_write("doc.write", tmp)
+    os.replace(tmp, path)
+
+
+def persist_blob(path, data):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    _commit_tmp(tmp, path)
+
+
+def append_event(path, line):
+    # Append-mode journals are torn-tail-tolerant by design — exempt.
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(line)

@@ -1,0 +1,19 @@
+"""Golden positive case for GL013 atomic-commit."""
+
+import json
+import os
+
+
+def persist_doc(path, doc):
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    # No fsync and no torn-write seam before the publish: a crash can
+    # surface a torn file under the committed name.
+    os.replace(tmp, path)
+
+
+def persist_blob(path, data):
+    # No rename and no blessed commit helper: non-atomic by construction.
+    with open(path, "wb") as f:
+        f.write(data)

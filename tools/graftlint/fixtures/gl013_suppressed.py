@@ -1,0 +1,8 @@
+"""Golden pragma-suppressed case for GL013 atomic-commit."""
+
+
+def write_boot_marker(path):
+    # One-shot boot marker: rewritten from scratch on every start and
+    # never trusted across a crash — atomicity buys nothing here.
+    with open(path, "w") as f:  # graftlint: disable=atomic-commit
+        f.write("ready\n")

@@ -1,0 +1,37 @@
+"""Golden negative case for GL014 fencing-discipline."""
+
+import threading
+
+JOB_PREFIX = "jobs/"
+
+
+class LeaseManager:
+    def __init__(self, store, peers):
+        self.store = store
+        self._peers = peers
+        self._snapshot = None
+        self._lock = threading.Lock()
+
+    def publish(self, job_id, data):
+        # The fence-token read dominates the write on every path.
+        lease = self._peers.lease()
+        self.store.put_fenced(JOB_PREFIX + job_id, data, lease)
+
+    def publish_inline(self, key, data):
+        self.store.put_fenced(key, data, self._peers.lease())
+
+    def scratch(self, data):
+        # Not a fenced prefix: raw put is fine outside jobs/, adopted/.
+        self.store.put("scratch/probe", data)
+
+    def snapshot(self):
+        # The lock guards in-memory snapshot state only.
+        with self._lock:
+            return self._snapshot
+
+    def read_outside_lock(self, key):
+        with self._lock:
+            pending = self._snapshot
+        if pending is not None:
+            return self.store.get(key)
+        return None

@@ -1,0 +1,32 @@
+"""Golden positive case for GL014 fencing-discipline."""
+
+import threading
+
+JOB_PREFIX = "jobs/"
+
+
+class LeaseManager:
+    def __init__(self, store, peers):
+        self.store = store
+        self._peers = peers
+        self._lease = None
+        self._lock = threading.Lock()
+
+    def clobber(self, job_id, data):
+        # Raw put into the fenced namespace bypasses the fence CAS.
+        self.store.put(JOB_PREFIX + job_id, data)
+
+    def stale_token(self, key, data):
+        # Attribute lease: the heartbeat thread may have replaced it.
+        self.store.put_fenced(key, data, self._lease)
+
+    def maybe_fresh(self, key, data, flag):
+        if flag:
+            lease = self._peers.lease()
+        # On the flag=False path the fence-token read never happened.
+        self.store.put_fenced(key, data, lease)
+
+    def io_under_lock(self, key):
+        with self._lock:
+            # Store I/O while the lease lock is held stalls heartbeats.
+            return self.store.get(key)

@@ -1,0 +1,13 @@
+"""Golden pragma-suppressed case for GL014 fencing-discipline."""
+
+JOB_PREFIX = "jobs/"
+
+
+class LeaseManager:
+    def __init__(self, store):
+        self.store = store
+
+    def bootstrap(self, job_id, data):
+        # Single-replica bootstrap runs before any peer exists, so the
+        # fence CAS has no contender to reject yet:
+        self.store.put(JOB_PREFIX + job_id, data)  # graftlint: disable=fencing-discipline

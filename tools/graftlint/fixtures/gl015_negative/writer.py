@@ -1,0 +1,17 @@
+"""Writers that cover the registry exactly — no drift either way."""
+
+
+def append_submit(journal, job_id, trace_id):
+    event = {"e": "submit", "id": job_id, "trace": trace_id}
+    journal.append(event)
+
+
+def append_done(journal, job_id):
+    journal.append({"e": "done", "id": job_id})
+
+
+def record_of(job):
+    rec = {"id": job.id, "state": job.state}
+    if job.error is not None:
+        rec["error"] = job.error
+    return rec

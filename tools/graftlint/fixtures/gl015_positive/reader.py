@@ -1,0 +1,10 @@
+"""Readers that subscript keys old journals do not carry."""
+
+
+def fold(path, replay_events):
+    jobs = {}
+    for e in replay_events(path):
+        jobs[e["id"]] = e["trace"]  # optional key, unguarded subscript
+        kind = e["unknown"]  # unregistered key
+        del kind
+    return jobs

@@ -1,0 +1,16 @@
+"""Writers that drift from the registry (every direction)."""
+
+
+def append_submit(journal, job_id):
+    event = {"e": "submit", "id": job_id, "shard": 3}  # unregistered key
+    journal.append(event)
+
+
+def append_retry(journal, job_id):
+    journal.append({"e": "retry", "id": job_id})  # unregistered kind
+
+
+def record_of(job):
+    rec = {"id": job.id, "state": job.state}
+    rec["attempts"] = job.attempts  # unregistered job-record key
+    return rec

@@ -1,0 +1,7 @@
+"""Fixture key registry (journal_schema stand-in)."""
+
+JOURNAL_EVENT_KINDS = ("submit", "done")
+JOURNAL_REQUIRED_KEYS = {"e", "id"}
+JOURNAL_OPTIONAL_KEYS = {"trace"}
+JOURNAL_KEYS = JOURNAL_REQUIRED_KEYS | JOURNAL_OPTIONAL_KEYS
+JOB_RECORD_KEYS = {"id", "state", "error"}

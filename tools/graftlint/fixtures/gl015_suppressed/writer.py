@@ -1,0 +1,20 @@
+"""Registry-complete writers plus one deliberate, pragma'd escape."""
+
+
+def append_submit(journal, job_id, trace_id):
+    journal.append({"e": "submit", "id": job_id, "trace": trace_id})
+
+
+def append_done(journal, job_id):
+    journal.append({"e": "done", "id": job_id})
+
+
+def append_debug(journal, job_id):
+    # Local debug-only event; a bench harness strips it before replay.
+    journal.append({"e": "done", "id": job_id, "scratch": 1})  # graftlint: disable=journal-compat
+
+
+def record_of(job):
+    rec = {"id": job.id, "state": job.state}
+    rec["error"] = job.error
+    return rec

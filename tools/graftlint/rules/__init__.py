@@ -6,15 +6,20 @@ their full configured scope regardless of CLI path narrowing), and
 ``check(project) -> Iterable[Finding]``.
 """
 
+from tools.graftlint.rules.atomic_commit import RULE as ATOMIC_COMMIT
 from tools.graftlint.rules.collective_congruence import (
     RULE as COLLECTIVE_CONGRUENCE,
 )
 from tools.graftlint.rules.deadlock_order import RULE as DEADLOCK_ORDER
 from tools.graftlint.rules.donation_aliasing import RULE as DONATION_ALIASING
 from tools.graftlint.rules.dtype_discipline import RULE as DTYPE_DISCIPLINE
+from tools.graftlint.rules.fencing_discipline import (
+    RULE as FENCING_DISCIPLINE,
+)
 from tools.graftlint.rules.flag_registry import RULE as FLAG_REGISTRY
 from tools.graftlint.rules.guarded_fields import RULE as GUARDED_FIELDS
 from tools.graftlint.rules.jit_purity import RULE as JIT_PURITY
+from tools.graftlint.rules.journal_compat import RULE as JOURNAL_COMPAT
 from tools.graftlint.rules.lock_discipline import RULE as LOCK_DISCIPLINE
 from tools.graftlint.rules.native_gil import RULE as NATIVE_GIL
 from tools.graftlint.rules.resilience_routing import RULE as RESILIENCE_ROUTING
@@ -36,6 +41,9 @@ ALL_RULES = [
     COLLECTIVE_CONGRUENCE,
     DONATION_ALIASING,
     RETRACE_DISCIPLINE,
+    ATOMIC_COMMIT,
+    FENCING_DISCIPLINE,
+    JOURNAL_COMPAT,
 ]
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,263 @@
+"""GL013 atomic-commit: every persisted file lands tmp→fsync→rename,
+with the torn-write seam on the path.
+
+Five independent persistence surfaces now hand-enforce the same
+commit discipline — the durable store (``store/local.py``), the job
+journal + delta cache (``serving/jobs.py``, ``serving/deltas.py``),
+the cohort mirror (``genomics/mirror.py``), and the crash flight
+recorder (``obs/flightrec.py``). The convention: a write targeting a
+persistence root is visible to readers only through an atomic rename
+of a fully-fsynced tmp file, and the write path carries the
+``faults.inject_write`` torn-write seam so the deterministic chaos
+suite (and crashsim) can reach it. A write that skips the fsync can
+surface TORN under its final name after a crash — the rename is
+journaled metadata, the data pages are not — and a write without the
+seam is invisible to every torn-write chaos scenario.
+
+Per function in a configured persistence root that performs a write —
+``open(..., "w"/"wb"/"x"...)`` (append-mode journals are exempt: they
+are torn-tail-tolerant by design, not rename-committed), ``np.save*``,
+or ``json.dump`` — the rule checks, flow-sensitively on the CFG:
+
+1. if the function renames (``os.replace``/``os.rename``): at every
+   rename node, an ``os.fsync`` must have occurred on EVERY path from
+   entry (must-event dataflow — this IS the fsync-before-rename order
+   check), and so must a ``faults.inject_write`` seam, unless a
+   blessed commit helper call (which owns both) dominates instead;
+2. if the function never renames: the write must flow through a
+   blessed commit helper (``_commit_tmp``, ``LocalDirStore.put`` —
+   the ``commit_helpers`` config key extends the set), else the write
+   is non-atomic by construction.
+
+Blessed helpers are blessed because they are themselves in scope and
+checked by (1) — the discipline bottoms out in a function this rule
+proves, not in a registry of trust.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import FrozenSet, Iterable, List, Optional, Tuple
+
+from tools.graftlint.astutil import call_name, last_component, literal_str
+from tools.graftlint.dataflow import (
+    build_cfg,
+    must_events,
+    node_scan_roots,
+    scan_calls,
+    walk_skip_nested,
+)
+from tools.graftlint.engine import Finding, Project
+
+NAME = "atomic-commit"
+CODE = "GL013"
+
+DEFAULT_PATHS = (
+    "spark_examples_tpu/store",
+    "spark_examples_tpu/serving/jobs.py",
+    "spark_examples_tpu/serving/deltas.py",
+    "spark_examples_tpu/genomics/mirror.py",
+    "spark_examples_tpu/obs/flightrec.py",
+)
+
+# Commit helpers that own the fsync + seam + rename internally. Their
+# own bodies are checked by this rule (they live in scope), so a call
+# to one blesses the caller's write without weakening the proof.
+DEFAULT_COMMIT_HELPERS = ("_commit_tmp", "LocalDirStore.put")
+
+_NP_WRITERS = frozenset({"save", "savez", "savez_compressed"})
+
+
+def _write_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode of an ``open(...)`` call, when it writes a new
+    file image ('w'/'x' modes). Read, append, and update-in-place
+    modes return None — append-mode journals are torn-tail-tolerant by
+    design and never rename-committed."""
+    if last_component(call_name(call)) != "open":
+        return None
+    mode_node: Optional[ast.AST] = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if mode_node is None:
+        return None
+    mode = literal_str(mode_node)
+    if mode is None:
+        return None
+    return mode if ("w" in mode or "x" in mode) else None
+
+
+def _is_write_call(call: ast.Call) -> bool:
+    if _write_mode(call) is not None:
+        return True
+    name = call_name(call)
+    last = last_component(name)
+    if last in _NP_WRITERS and name and name.split(".")[0] in (
+        "np",
+        "numpy",
+        "jnp",
+    ):
+        return True
+    if last == "dump" and name and name.split(".")[0] == "json":
+        return True
+    return False
+
+
+def _is_rename_call(call: ast.Call) -> bool:
+    return call_name(call) in ("os.replace", "os.rename")
+
+
+def _is_fsync_call(call: ast.Call) -> bool:
+    return last_component(call_name(call)) == "fsync"
+
+
+def _is_seam_call(call: ast.Call) -> bool:
+    return last_component(call_name(call)) == "inject_write"
+
+
+def _is_helper_call(call: ast.Call, helpers: FrozenSet[str]) -> bool:
+    last = last_component(call_name(call))
+    return last is not None and last in helpers
+
+
+class AtomicCommitRule:
+    name = NAME
+    code = CODE
+    summary = (
+        "persistence-root writes commit tmp→fsync→atomic-rename with "
+        "the faults.inject_write torn seam on the path (or flow "
+        "through a blessed commit helper)"
+    )
+    project_wide = False
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        helpers = frozenset(
+            last_component(h) or h
+            for h in self._helpers(project)
+        )
+        findings: List[Finding] = []
+        for top in project.rule_paths(NAME, DEFAULT_PATHS):
+            for rel in project.walk(top):
+                ctx = project.file(rel)
+                if ctx is None or ctx.tree is None:
+                    continue
+                for fn in _functions(ctx.tree):
+                    findings.extend(
+                        self._check_function(rel, fn, helpers)
+                    )
+        return findings
+
+    def _helpers(self, project: Project) -> Tuple[str, ...]:
+        cfg = project.config.get("rules", {}).get(NAME, {})
+        return tuple(cfg.get("commit_helpers", DEFAULT_COMMIT_HELPERS))
+
+    def _check_function(
+        self, rel: str, fn: ast.AST, helpers: FrozenSet[str]
+    ) -> List[Finding]:
+        writes: List[ast.Call] = []
+        renames = False
+        helper_called = False
+        for node in walk_skip_nested(fn, skip_self=True):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_write_call(node):
+                writes.append(node)
+            elif _is_rename_call(node):
+                renames = True
+            elif _is_helper_call(node, helpers):
+                helper_called = True
+        if not writes:
+            return []
+        if not renames:
+            if helper_called:
+                return []
+            return [
+                Finding(
+                    NAME,
+                    CODE,
+                    rel,
+                    w.lineno,
+                    "write to a persistence root with no atomic commit: "
+                    "no os.replace/os.rename in this function and no "
+                    "blessed commit helper call "
+                    f"({', '.join(sorted(helpers))}) — a crash here "
+                    "leaves a partial file readers will trust",
+                )
+                for w in writes
+            ]
+        return self._check_rename_paths(rel, fn, helpers)
+
+    def _check_rename_paths(
+        self, rel: str, fn: ast.AST, helpers: FrozenSet[str]
+    ) -> List[Finding]:
+        cfg = build_cfg(fn, lambda expr: None)
+
+        def events_at(node) -> FrozenSet[str]:
+            tags = set()
+            for root in node_scan_roots(node):
+                for call in scan_calls(root):
+                    if _is_fsync_call(call):
+                        tags.add("fsync")
+                    if _is_seam_call(call):
+                        tags.add("seam")
+                    if _is_helper_call(call, helpers):
+                        tags.update(("fsync", "seam"))
+            return frozenset(tags)
+
+        in_states = must_events(cfg, events_at)
+        findings: List[Finding] = []
+        for node in cfg.nodes:
+            rename_line = None
+            for root in node_scan_roots(node):
+                for call in scan_calls(root):
+                    if _is_rename_call(call):
+                        rename_line = call.lineno
+            if rename_line is None:
+                continue
+            state = in_states.get(node)
+            if state is None:
+                continue  # unreachable rename
+            if "fsync" not in state:
+                findings.append(
+                    Finding(
+                        NAME,
+                        CODE,
+                        rel,
+                        rename_line,
+                        "atomic rename without fsync on every path from "
+                        "entry: the rename is journaled metadata but the "
+                        "data pages are not — a crash can surface a TORN "
+                        "file under the committed name",
+                    )
+                )
+            if "seam" not in state:
+                findings.append(
+                    Finding(
+                        NAME,
+                        CODE,
+                        rel,
+                        rename_line,
+                        "commit path without the faults.inject_write torn-"
+                        "write seam: the deterministic chaos suite (and "
+                        "crashsim) cannot reach this write — add the seam "
+                        "before the rename",
+                    )
+                )
+        return findings
+
+
+def _functions(tree: ast.AST) -> Iterable[ast.AST]:
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+        elif isinstance(node, ast.ClassDef):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    yield sub
+
+
+RULE = AtomicCommitRule()

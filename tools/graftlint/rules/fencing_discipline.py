@@ -1,0 +1,354 @@
+"""GL014 fencing-discipline: fenced namespaces, fresh tokens, no store
+I/O under the lease lock.
+
+Replicated serving (PR 17) owes its kill-any-replica safety to three
+conventions that nothing machine-checked until now:
+
+1. **fenced namespaces are written fenced** — keys under a shared
+   prefix (``jobs/``, ``adopted/``) are contended between replicas;
+   writing one with raw ``.put(...)`` / deleting with ``.delete(...)``
+   bypasses the fence-token CAS and lets a zombie replica clobber the
+   rightful owner's state. Any store write whose key is (or is built
+   from a module constant bound to) a fenced prefix must go through
+   ``put_fenced``.
+2. **the fence-token read dominates the write** — the ``lease``
+   argument handed to ``put_fenced`` must be provably fresh on EVERY
+   CFG path: assigned from a ``.lease()`` / ``lease_acquire(...)``
+   call earlier in the same function (must-event dataflow), or be the
+   call itself inline. Passing a lease held in an attribute
+   (``self._lease``) is a stale-token hazard — the snapshot the
+   heartbeat thread replaces is not the snapshot you fenced with.
+3. **no store I/O while ``LeaseManager._lock`` is must-held** — the
+   CONCURRENCY.md non-edge: the lease lock guards in-memory snapshot
+   state only; store calls block on disk (and on the store's own
+   dir-mutex), and holding the lease lock across one stalls the
+   heartbeat thread into lease expiry — the outage it exists to
+   prevent. This rule machine-checks the documented non-edge, so the
+   CONCURRENCY.md lock graph stays edge-free by proof, not by prose.
+
+Config (``[tool.graftlint.rules.fencing-discipline]``):
+``fenced_prefixes`` (default ``["jobs/", "adopted/"]``) and
+``no_store_io_locks`` (default ``["LeaseManager._lock"]``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint.astutil import call_name, last_component, literal_str
+from tools.graftlint.dataflow import (
+    build_cfg,
+    class_lock_keys,
+    held_at_nodes,
+    make_resolver,
+    must_events,
+    node_scan_roots,
+    scan_calls,
+)
+from tools.graftlint.engine import Finding, Project
+
+NAME = "fencing-discipline"
+CODE = "GL014"
+
+DEFAULT_PATHS = ("spark_examples_tpu/serving",)
+
+DEFAULT_FENCED_PREFIXES = ("jobs/", "adopted/")
+DEFAULT_NO_STORE_IO_LOCKS = ("LeaseManager._lock",)
+
+# Calls that acquire/refresh a fence token. ``.lease()`` is the
+# LeaseManager snapshot read; ``lease_acquire`` is the store CAS.
+_LEASE_SOURCES = frozenset({"lease", "lease_acquire"})
+
+# The DurableStore surface: any of these on a store-like receiver is
+# I/O that blocks on disk (and the store's dir-mutex).
+_STORE_IO = frozenset(
+    {
+        "put",
+        "put_fenced",
+        "get",
+        "delete",
+        "list_keys",
+        "check_fence",
+        "lease_acquire",
+        "lease_renew",
+        "lease_release",
+        "lease_get",
+        "lease_list",
+        "now",
+    }
+)
+
+
+def _store_like(expr: ast.AST) -> bool:
+    """True when the receiver reads as a durable store: its trailing
+    name word-contains "store" (``self.store``, ``replica.store``,
+    ``self._store``)."""
+    name = None
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    if name is None:
+        return False
+    return "store" in name.lower().split("_") or name.lower() == "store"
+
+
+def _fenced_constants(
+    project: Project, tops: Iterable[str], prefixes: Tuple[str, ...]
+) -> Set[str]:
+    """Module-level ``NAME = "jobs/"``-style constants across the scope
+    whose literal value starts with a fenced prefix. Matched by bare
+    name at use sites — imports re-bind the same name."""
+    consts: Set[str] = set()
+    for top in tops:
+        for rel in project.walk(top):
+            ctx = project.file(rel)
+            if ctx is None or ctx.tree is None:
+                continue
+            for node in ast.iter_child_nodes(ctx.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                value = literal_str(node.value)
+                if value is None:
+                    continue
+                if not any(value.startswith(p) for p in prefixes):
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        consts.add(tgt.id)
+    return consts
+
+
+def _key_is_fenced(
+    key: ast.AST, prefixes: Tuple[str, ...], consts: Set[str]
+) -> bool:
+    """Does this key expression target a fenced namespace? Literal
+    prefix match, a fenced constant by name, or ``CONST + <expr>`` /
+    ``"jobs/" + <expr>`` concatenation."""
+    lit = literal_str(key)
+    if lit is not None:
+        return any(lit.startswith(p) for p in prefixes)
+    if isinstance(key, ast.Name):
+        return key.id in consts
+    if isinstance(key, ast.BinOp) and isinstance(key.op, ast.Add):
+        return _key_is_fenced(key.left, prefixes, consts)
+    if isinstance(key, ast.JoinedStr) and key.values:
+        return _key_is_fenced(key.values[0], prefixes, consts)
+    return False
+
+
+def _lease_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The lease argument of a ``put_fenced(key, data, lease)`` call."""
+    for kw in call.keywords:
+        if kw.arg == "lease":
+            return kw.value
+    if len(call.args) >= 3:
+        return call.args[2]
+    return None
+
+
+class FencingDisciplineRule:
+    name = NAME
+    code = CODE
+    summary = (
+        "fenced-namespace writes go through put_fenced with a fence "
+        "token read that dominates the write; no store I/O while the "
+        "lease lock is held"
+    )
+    # Fenced-prefix constants are defined in one module and used from
+    # another — the constant map must see the whole scope even when the
+    # CLI restricts paths.
+    project_wide = True
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        cfg = project.config.get("rules", {}).get(NAME, {})
+        prefixes = tuple(
+            cfg.get("fenced_prefixes", DEFAULT_FENCED_PREFIXES)
+        )
+        io_locks = frozenset(
+            cfg.get("no_store_io_locks", DEFAULT_NO_STORE_IO_LOCKS)
+        )
+        tops = tuple(project.rule_paths(NAME, DEFAULT_PATHS))
+        consts = _fenced_constants(project, tops, prefixes)
+        findings: List[Finding] = []
+        for top in tops:
+            for rel in project.walk(top):
+                ctx = project.file(rel)
+                if ctx is None or ctx.tree is None:
+                    continue
+                stem = os.path.splitext(os.path.basename(rel))[0]
+                for cls, fn in _functions_with_context(ctx.tree):
+                    findings.extend(
+                        self._check_function(
+                            rel, stem, cls, fn, prefixes, consts, io_locks
+                        )
+                    )
+        return findings
+
+    def _check_function(
+        self,
+        rel: str,
+        stem: str,
+        cls: Optional[ast.ClassDef],
+        fn: ast.AST,
+        prefixes: Tuple[str, ...],
+        consts: Set[str],
+        io_locks: FrozenSet[str],
+    ) -> List[Finding]:
+        findings: List[Finding] = []
+        cls_name = cls.name if cls is not None else None
+        resolve = make_resolver(cls_name, stem)
+        cfg = build_cfg(fn, resolve)
+
+        # (b) setup: which names hold a provably-fresh fence token at
+        # each point — gen at assignments from a lease-source call.
+        def events_at(node) -> FrozenSet[str]:
+            tags: Set[str] = set()
+            for root in node_scan_roots(node):
+                if not isinstance(root, ast.Assign):
+                    continue
+                fresh = any(
+                    last_component(call_name(c)) in _LEASE_SOURCES
+                    for c in scan_calls(root.value)
+                )
+                if not fresh:
+                    continue
+                for tgt in root.targets:
+                    if isinstance(tgt, ast.Name):
+                        tags.add(f"lease:{tgt.id}")
+            return frozenset(tags)
+
+        fresh_at = must_events(cfg, events_at)
+
+        # (c) setup: must-held lock state, seeded per the *_locked
+        # convention so LeaseManager's own _locked helpers verify.
+        own_locks = (
+            class_lock_keys(cls, stem) if cls is not None else frozenset()
+        )
+        seed = (
+            own_locks
+            if fn.name.endswith("_locked") and own_locks
+            else frozenset()
+        )
+        held = held_at_nodes(cfg, resolve, seed=seed, must=True)
+
+        for node in cfg.nodes:
+            fresh = fresh_at.get(node)
+            held_here = held.get(node)
+            for root in node_scan_roots(node):
+                for call in scan_calls(root):
+                    func = call.func
+                    if not isinstance(func, ast.Attribute):
+                        continue
+                    # (a) raw put/delete into a fenced namespace.
+                    if (
+                        func.attr in ("put", "delete")
+                        and call.args
+                        and _key_is_fenced(call.args[0], prefixes, consts)
+                    ):
+                        verb = (
+                            "written" if func.attr == "put" else "deleted"
+                        )
+                        findings.append(
+                            Finding(
+                                NAME,
+                                CODE,
+                                rel,
+                                call.lineno,
+                                f"fenced namespace {verb} with raw "
+                                f"`.{func.attr}(...)`: keys under "
+                                f"{', '.join(prefixes)} are contended "
+                                "between replicas — route through "
+                                "put_fenced so a zombie's stale token "
+                                "is rejected by the CAS",
+                            )
+                        )
+                    # (b) put_fenced with a non-dominating token read.
+                    if func.attr == "put_fenced":
+                        findings.extend(
+                            self._check_token(rel, call, fresh)
+                        )
+                    # (c) store I/O under the lease lock.
+                    if (
+                        func.attr in _STORE_IO
+                        and _store_like(func.value)
+                        and held_here is not None
+                        and held_here & io_locks
+                    ):
+                        locks = ", ".join(sorted(held_here & io_locks))
+                        findings.append(
+                            Finding(
+                                NAME,
+                                CODE,
+                                rel,
+                                call.lineno,
+                                f"store I/O (`.{func.attr}(...)`) while "
+                                f"{locks} is held on every path — the "
+                                "lease lock guards in-memory snapshots "
+                                "only; blocking on the store under it "
+                                "stalls the heartbeat into lease expiry "
+                                "(the CONCURRENCY.md non-edge)",
+                            )
+                        )
+        return findings
+
+    def _check_token(
+        self,
+        rel: str,
+        call: ast.Call,
+        fresh: Optional[FrozenSet[str]],
+    ) -> List[Finding]:
+        arg = _lease_arg(call)
+        if arg is None:
+            return []  # arity error — not this rule's problem
+        if isinstance(arg, ast.Call):
+            if last_component(call_name(arg)) in _LEASE_SOURCES:
+                return []  # token read inline at the write — fresh
+        if isinstance(arg, ast.Name):
+            if fresh is not None and f"lease:{arg.id}" in fresh:
+                return []
+            return [
+                Finding(
+                    NAME,
+                    CODE,
+                    rel,
+                    call.lineno,
+                    f"put_fenced with lease `{arg.id}` whose fence-token "
+                    "read does not dominate the write: on some path "
+                    "from entry it was never assigned from .lease() / "
+                    "lease_acquire(...) in this function — read the "
+                    "token on every path that reaches the write",
+                )
+            ]
+        return [
+            Finding(
+                NAME,
+                CODE,
+                rel,
+                call.lineno,
+                "put_fenced with a stored lease (attribute/expression) "
+                "instead of a locally-read token: the snapshot the "
+                "heartbeat thread replaces is not the snapshot you "
+                "fenced with — assign `lease = <mgr>.lease()` at the "
+                "write site",
+            )
+        ]
+
+
+def _functions_with_context(
+    tree: ast.AST,
+) -> Iterable[Tuple[Optional[ast.ClassDef], ast.AST]]:
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    yield node, sub
+
+
+RULE = FencingDisciplineRule()

@@ -1,0 +1,532 @@
+"""GL015 journal-compat: journal/job-record keys come from ONE registry,
+and new-version keys are absence-tolerant on read.
+
+The job journal is append-only and accumulates across server
+generations: a round-6 journal replays under round-18 code, and a
+record written today must still fold correctly under next year's
+reader. That compatibility contract has two failure modes, both
+silent: a writer emits a key the replay readers never learned
+(orphaned data — or worse, a reader that would have dispatched on it
+skips it forever), or a reader subscripts a key that old records do
+not carry (every pre-upgrade journal becomes a KeyError at recovery
+time, which ``_replay``'s tolerant fold downgrades to dropped jobs).
+
+This rule is the GL003 schema-sharing pattern applied to durability.
+``spark_examples_tpu/serving/journal_schema.py`` (configurable via
+``registry_module``) is the single key registry; the rule
+importlib-loads it — the same name sets the mixed-version replay test
+and the crashsim journal scenario consume — and checks, across the
+serving scope:
+
+- **writers**: every key in a journal-event dict literal (a dict with
+  a literal ``"e"`` key) or augmented onto one by subscript-assign
+  must be registered; the ``"e"`` value must be a registered event
+  kind. Job-record literals (``Job.to_record`` shape: literal ``"id"``
+  + ``"state"`` keys) and subscript-augments on variables bound from
+  ``record_of``/``to_record``/``job_record`` calls must use registered
+  job-record keys.
+- **readers**: inside any function that calls ``replay_events``,
+  event-dict accesses must use registered keys, and OPTIONAL keys
+  (post-round-6 additions: ``trace``, ``replica``, ``fence``, ...)
+  must be read tolerantly — ``e.get(k)``, or a subscript guarded by an
+  ``e.get(k)`` in the same statement.
+- **staleness** (the other drift direction): a registered key that no
+  writer in scope ever emits is a finding at the registry — the
+  registry must describe the code, not a remembered version of it.
+
+Absent registry module (fixture mini-projects) disables the rule, as
+GL003 does when ``validate_trace.py`` is missing.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import (
+    Any,
+    FrozenSet,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Set,
+    Tuple,
+)
+
+from tools.graftlint.astutil import call_name, last_component, literal_str
+from tools.graftlint.dataflow import walk_skip_nested
+from tools.graftlint.engine import Finding, Project
+
+NAME = "journal-compat"
+CODE = "GL015"
+
+DEFAULT_PATHS = ("spark_examples_tpu/serving",)
+DEFAULT_REGISTRY = "spark_examples_tpu/serving/journal_schema.py"
+
+# Calls whose result is a serialized job record; subscript-assigns on
+# the bound variable are job-record writes.
+_RECORD_SOURCES = frozenset({"record_of", "to_record", "job_record"})
+
+_REGISTRY_NAMES = (
+    "JOURNAL_EVENT_KINDS",
+    "JOURNAL_REQUIRED_KEYS",
+    "JOURNAL_OPTIONAL_KEYS",
+    "JOURNAL_KEYS",
+    "JOB_RECORD_KEYS",
+)
+
+
+def load_registry(root: str, rel: str) -> Optional[Any]:
+    """Import the key registry from the project root (stdlib-only by
+    contract; None when absent, e.g. in fixture mini-projects)."""
+    path = os.path.join(root, rel)
+    if not os.path.exists(path):
+        return None
+    spec = importlib.util.spec_from_file_location(
+        "graftlint_journal_schema", path
+    )
+    if spec is None or spec.loader is None:
+        return None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _dict_literal_keys(
+    node: ast.Dict,
+) -> List[Tuple[str, ast.AST]]:
+    """(literal key, value expr) pairs; non-literal keys skipped."""
+    out: List[Tuple[str, ast.AST]] = []
+    for k, v in zip(node.keys, node.values):
+        if k is None:
+            continue  # **spread — opaque
+        lit = literal_str(k)
+        if lit is not None:
+            out.append((lit, v))
+    return out
+
+
+def _stmt_exprs(stmt: ast.AST) -> Iterator[ast.AST]:
+    """Expression subtrees owned by ONE statement — nested statements
+    (compound bodies) and nested defs are someone else's scope."""
+    stack: List[ast.AST] = []
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, (ast.stmt, ast.excepthandler)):
+            continue
+        stack.append(child)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (
+                    ast.stmt,
+                    ast.excepthandler,
+                    ast.FunctionDef,
+                    ast.AsyncFunctionDef,
+                    ast.Lambda,
+                    ast.ClassDef,
+                ),
+            ):
+                continue
+            stack.append(child)
+
+
+def _subscript_key(node: ast.Subscript) -> Optional[str]:
+    sl = node.slice
+    # py3.8 ast.Index compatibility is not needed — repo floor is 3.9.
+    return literal_str(sl)
+
+
+class JournalCompatRule:
+    name = NAME
+    code = CODE
+    summary = (
+        "journal/job-record keys come from the shared registry module; "
+        "post-round-6 keys are absence-tolerant on read; the registry "
+        "never goes stale"
+    )
+    # Writers, readers, and the registry live in different files — the
+    # staleness direction needs the whole scope even when the CLI
+    # restricts paths.
+    project_wide = True
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        cfg = project.config.get("rules", {}).get(NAME, {})
+        registry_rel = cfg.get("registry_module", DEFAULT_REGISTRY)
+        registry = load_registry(project.root, registry_rel)
+        if registry is None:
+            return []
+        missing = [
+            n for n in _REGISTRY_NAMES if not hasattr(registry, n)
+        ]
+        if missing:
+            return [
+                Finding(
+                    NAME,
+                    CODE,
+                    registry_rel,
+                    1,
+                    f"registry module lacks {', '.join(missing)} — the "
+                    "shared-schema contract needs every name set",
+                )
+            ]
+        journal_keys = frozenset(registry.JOURNAL_KEYS)
+        optional_keys = frozenset(registry.JOURNAL_OPTIONAL_KEYS)
+        event_kinds = frozenset(registry.JOURNAL_EVENT_KINDS)
+        record_keys = frozenset(registry.JOB_RECORD_KEYS)
+
+        findings: List[Finding] = []
+        written_journal: Set[str] = set()
+        written_record: Set[str] = set()
+        for top in project.rule_paths(NAME, DEFAULT_PATHS):
+            for rel in project.walk(top):
+                ctx = project.file(rel)
+                if ctx is None or ctx.tree is None:
+                    continue
+                if os.path.normpath(rel) == os.path.normpath(
+                    registry_rel
+                ):
+                    continue  # the registry is the spec, not a writer
+                for fn in _functions(ctx.tree):
+                    findings.extend(
+                        self._check_writers(
+                            rel,
+                            fn,
+                            journal_keys,
+                            event_kinds,
+                            record_keys,
+                            written_journal,
+                            written_record,
+                        )
+                    )
+                    findings.extend(
+                        self._check_readers(
+                            rel, fn, journal_keys, optional_keys
+                        )
+                    )
+        for key in sorted(journal_keys - written_journal):
+            findings.append(
+                Finding(
+                    NAME,
+                    CODE,
+                    registry_rel,
+                    1,
+                    f"registered journal key {key!r} is written by no "
+                    "serialization site in scope — stale registry "
+                    "entries teach readers to tolerate keys that "
+                    "cannot exist; remove it or restore the writer",
+                )
+            )
+        for key in sorted(record_keys - written_record):
+            findings.append(
+                Finding(
+                    NAME,
+                    CODE,
+                    registry_rel,
+                    1,
+                    f"registered job-record key {key!r} is written by "
+                    "no serialization site in scope — remove it or "
+                    "restore the writer",
+                )
+            )
+        return findings
+
+    # -- writers ---------------------------------------------------------------
+
+    def _check_writers(
+        self,
+        rel: str,
+        fn: ast.AST,
+        journal_keys: FrozenSet[str],
+        event_kinds: FrozenSet[str],
+        record_keys: FrozenSet[str],
+        written_journal: Set[str],
+        written_record: Set[str],
+    ) -> List[Finding]:
+        findings: List[Finding] = []
+        journal_vars: Set[str] = set()
+        record_vars: Set[str] = set()
+
+        # First pass: dict literals + the variables bound to them.
+        for node in walk_skip_nested(fn, skip_self=True):
+            value = getattr(node, "value", None)
+            if isinstance(
+                node, (ast.Assign, ast.AnnAssign)
+            ) and isinstance(value, ast.Call):
+                if (
+                    last_component(call_name(value))
+                    in _RECORD_SOURCES
+                ):
+                    for tgt in _assign_targets(node):
+                        if isinstance(tgt, ast.Name):
+                            record_vars.add(tgt.id)
+            if not isinstance(node, ast.Dict):
+                continue
+            pairs = _dict_literal_keys(node)
+            keys = {k for k, _ in pairs}
+            if "e" in keys:
+                bound = _bound_names(fn, node)
+                journal_vars.update(bound)
+                for key, value in pairs:
+                    written_journal.add(key)
+                    if key not in journal_keys:
+                        findings.append(
+                            Finding(
+                                NAME,
+                                CODE,
+                                rel,
+                                node.lineno,
+                                f"journal event written with key {key!r} "
+                                "not in the shared registry "
+                                "(journal_schema.JOURNAL_KEYS) — a key "
+                                "the replay readers never learned is "
+                                "orphaned data; register it and decide "
+                                "its absence-tolerance",
+                            )
+                        )
+                    if key == "e":
+                        kind = literal_str(value)
+                        if kind is not None and kind not in event_kinds:
+                            findings.append(
+                                Finding(
+                                    NAME,
+                                    CODE,
+                                    rel,
+                                    node.lineno,
+                                    f"journal event kind {kind!r} not in "
+                                    "journal_schema.JOURNAL_EVENT_KINDS "
+                                    "— replay folds unknown kinds as "
+                                    "corruption",
+                                )
+                            )
+            elif keys >= {"id", "state"}:
+                bound = _bound_names(fn, node)
+                record_vars.update(bound)
+                for key, _ in pairs:
+                    written_record.add(key)
+                    if key not in record_keys:
+                        findings.append(
+                            Finding(
+                                NAME,
+                                CODE,
+                                rel,
+                                node.lineno,
+                                f"job record written with key {key!r} "
+                                "not in journal_schema.JOB_RECORD_KEYS "
+                                "— every record consumer treats the "
+                                "record as the registry's closed set",
+                            )
+                        )
+
+        # Second pass: subscript-assign augments on bound variables.
+        for node in walk_skip_nested(fn, skip_self=True):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if not (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Name)
+                ):
+                    continue
+                key = _subscript_key(tgt)
+                if key is None:
+                    continue
+                var = tgt.value.id
+                if var in journal_vars:
+                    written_journal.add(key)
+                    if key not in journal_keys:
+                        findings.append(
+                            Finding(
+                                NAME,
+                                CODE,
+                                rel,
+                                node.lineno,
+                                f"journal event augmented with key "
+                                f"{key!r} not in the shared registry — "
+                                "register it and decide its "
+                                "absence-tolerance",
+                            )
+                        )
+                elif var in record_vars:
+                    written_record.add(key)
+                    if key not in record_keys:
+                        findings.append(
+                            Finding(
+                                NAME,
+                                CODE,
+                                rel,
+                                node.lineno,
+                                f"job record augmented with key {key!r} "
+                                "not in "
+                                "journal_schema.JOB_RECORD_KEYS",
+                            )
+                        )
+        return findings
+
+    # -- readers ---------------------------------------------------------------
+
+    def _check_readers(
+        self,
+        rel: str,
+        fn: ast.AST,
+        journal_keys: FrozenSet[str],
+        optional_keys: FrozenSet[str],
+    ) -> List[Finding]:
+        replays = any(
+            last_component(call_name(c)) == "replay_events"
+            for c in _calls(fn)
+        )
+        if not replays:
+            return []
+        event_vars = _replay_event_vars(fn)
+        if not event_vars:
+            return []
+        findings: List[Finding] = []
+        for stmt in walk_skip_nested(fn, skip_self=True):
+            if not isinstance(stmt, ast.stmt):
+                continue
+            # .get(k) guards present in this statement, per variable.
+            guarded: Set[Tuple[str, str]] = set()
+            accesses: List[Tuple[str, str, bool, int]] = []
+            for expr in _stmt_exprs(stmt):
+                if (
+                    isinstance(expr, ast.Call)
+                    and isinstance(expr.func, ast.Attribute)
+                    and expr.func.attr == "get"
+                    and isinstance(expr.func.value, ast.Name)
+                    and expr.func.value.id in event_vars
+                    and expr.args
+                ):
+                    key = literal_str(expr.args[0])
+                    if key is not None:
+                        var = expr.func.value.id
+                        guarded.add((var, key))
+                        accesses.append((var, key, True, expr.lineno))
+                elif (
+                    isinstance(expr, ast.Subscript)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id in event_vars
+                    and not isinstance(expr.ctx, ast.Store)
+                ):
+                    key = _subscript_key(expr)
+                    if key is not None:
+                        accesses.append(
+                            (expr.value.id, key, False, expr.lineno)
+                        )
+            for var, key, tolerant, line in accesses:
+                if key not in journal_keys:
+                    findings.append(
+                        Finding(
+                            NAME,
+                            CODE,
+                            rel,
+                            line,
+                            f"replay reader accesses journal key {key!r} "
+                            "not in the shared registry — a reader "
+                            "dispatching on an unregistered key reads a "
+                            "key no writer is checked to emit",
+                        )
+                    )
+                elif (
+                    key in optional_keys
+                    and not tolerant
+                    and (var, key) not in guarded
+                ):
+                    findings.append(
+                        Finding(
+                            NAME,
+                            CODE,
+                            rel,
+                            line,
+                            f"replay reader subscripts OPTIONAL journal "
+                            f"key {key!r} without a guarding "
+                            f"`.get({key!r})` in the same statement — "
+                            "pre-upgrade journals do not carry it, and "
+                            "the KeyError at replay time drops the job",
+                        )
+                    )
+        return findings
+
+
+def _assign_targets(node: ast.AST) -> List[ast.expr]:
+    """Bind targets of plain and annotated assignments alike —
+    ``event: Dict[str, Any] = {...}`` binds exactly as ``event = {...}``
+    does."""
+    if isinstance(node, ast.Assign):
+        return list(node.targets)
+    if isinstance(node, ast.AnnAssign) and node.value is not None:
+        return [node.target]
+    return []
+
+
+def _bound_names(fn: ast.AST, dict_node: ast.Dict) -> Set[str]:
+    """Names the function binds directly to this dict literal."""
+    out: Set[str] = set()
+    for node in walk_skip_nested(fn, skip_self=True):
+        if getattr(node, "value", None) is dict_node:
+            for tgt in _assign_targets(node):
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _replay_event_vars(fn: ast.AST) -> Set[str]:
+    """Loop variables that iterate journal events: ``for e in
+    replay_events(...)`` directly, or through a variable bound to the
+    replay result (optionally via ``list(...)``)."""
+    replay_bound: Set[str] = set()
+    for node in walk_skip_nested(fn, skip_self=True):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if (
+            isinstance(value, ast.Call)
+            and last_component(call_name(value)) == "list"
+            and value.args
+        ):
+            value = value.args[0]
+        if (
+            isinstance(value, ast.Call)
+            and last_component(call_name(value)) == "replay_events"
+        ):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    replay_bound.add(tgt.id)
+    out: Set[str] = set()
+    for node in walk_skip_nested(fn, skip_self=True):
+        if not isinstance(node, (ast.For, ast.AsyncFor)):
+            continue
+        src = node.iter
+        from_replay = (
+            isinstance(src, ast.Call)
+            and last_component(call_name(src)) == "replay_events"
+        ) or (
+            isinstance(src, ast.Name) and src.id in replay_bound
+        )
+        if from_replay and isinstance(node.target, ast.Name):
+            out.add(node.target.id)
+    return out
+
+
+def _calls(fn: ast.AST) -> Iterator[ast.Call]:
+    for node in walk_skip_nested(fn, skip_self=True):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _functions(tree: ast.AST) -> Iterator[ast.AST]:
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+        elif isinstance(node, ast.ClassDef):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    yield sub
+
+
+RULE = JournalCompatRule()
